@@ -1,0 +1,11 @@
+"""elasticdl_tpu: a TPU-native elastic distributed training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of ElasticDL (reference:
+zerocurve/elasticdl): a master that owns dynamic data sharding and elastic
+worker membership, fault-tolerant data-parallel training (the reference's
+FTlib/Horovod NCCL AllReduce re-emitted as XLA `psum` collectives over ICI),
+and parameter-server-style embedding tables re-emitted as HBM-sharded arrays
+with `all_to_all` lookup compiled into the jit step.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,193 @@
+"""Centralized flag system.
+
+Parity: elasticdl/python/common/args.py in the reference — flat argparse with
+distinct parser assemblies per role (master / worker / CLI) sharing flag
+groups; unknown flags round-trip client -> master -> worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def pos_int(value):
+    ivalue = int(value)
+    if ivalue <= 0:
+        raise argparse.ArgumentTypeError(f"{value} must be a positive integer")
+    return ivalue
+
+
+def non_neg_int(value):
+    ivalue = int(value)
+    if ivalue < 0:
+        raise argparse.ArgumentTypeError(f"{value} must be >= 0")
+    return ivalue
+
+
+def str2bool(value):
+    if isinstance(value, bool):
+        return value
+    if value.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if value.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"Cannot parse bool from {value!r}")
+
+
+def add_common_arguments(parser: argparse.ArgumentParser):
+    parser.add_argument("--job_name", default="elasticdl-job", help="Job name")
+    parser.add_argument(
+        "--distribution_strategy",
+        default="Local",
+        choices=["Local", "ParameterServerStrategy", "AllreduceStrategy"],
+        help="Local, ParameterServerStrategy (sharded-embedding data plane) "
+        "or AllreduceStrategy (psum over ICI)",
+    )
+    parser.add_argument("--log_level", default="INFO")
+
+
+def add_model_zoo_arguments(parser: argparse.ArgumentParser):
+    parser.add_argument(
+        "--model_zoo", required=True, help="Directory or module path of the model zoo"
+    )
+    parser.add_argument(
+        "--model_def",
+        required=True,
+        help="Model module within the zoo, e.g. mnist.mnist_functional_api",
+    )
+    parser.add_argument(
+        "--model_params",
+        default="",
+        help="Comma-separated key=value pairs passed to custom_model()",
+    )
+    parser.add_argument("--dataset_fn", default="dataset_fn")
+    parser.add_argument("--loss", default="loss")
+    parser.add_argument("--optimizer", default="optimizer")
+    parser.add_argument("--eval_metrics_fn", default="eval_metrics_fn")
+    parser.add_argument("--custom_data_reader", default="custom_data_reader")
+    parser.add_argument("--callbacks", default="callbacks")
+
+
+def add_data_arguments(parser: argparse.ArgumentParser):
+    parser.add_argument("--training_data", default="", help="Training data path/pattern")
+    parser.add_argument("--validation_data", default="", help="Validation data path")
+    parser.add_argument("--prediction_data", default="", help="Prediction data path")
+    parser.add_argument("--records_per_task", type=pos_int, default=4096)
+    parser.add_argument("--minibatch_size", type=pos_int, default=64)
+    parser.add_argument("--num_epochs", type=pos_int, default=1)
+    parser.add_argument(
+        "--data_reader_params",
+        default="",
+        help="Comma-separated key=value pairs passed to the data reader",
+    )
+
+
+def add_train_arguments(parser: argparse.ArgumentParser):
+    parser.add_argument("--evaluation_steps", type=non_neg_int, default=0,
+                        help="Evaluate every N steps (0: per epoch)")
+    parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=3)
+    parser.add_argument("--output", default="", help="Trained model output path")
+    parser.add_argument("--tensorboard_log_dir", default="")
+    parser.add_argument("--task_timeout_s", type=non_neg_int, default=0)
+    parser.add_argument("--use_bf16", type=str2bool, nargs="?", const=True,
+                        default=True, help="Compute in bfloat16 on the MXU")
+
+
+def add_cluster_arguments(parser: argparse.ArgumentParser):
+    parser.add_argument("--num_workers", type=pos_int, default=1)
+    parser.add_argument("--master_addr", default="", help="host:port of the master")
+    parser.add_argument("--master_port", type=non_neg_int, default=0,
+                        help="0 picks a free port")
+    parser.add_argument("--worker_pod_priority", default="")
+    parser.add_argument("--max_worker_restarts", type=non_neg_int, default=3)
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--image_name", default="")
+    parser.add_argument(
+        "--need_elasticity", type=str2bool, nargs="?", const=True, default=True
+    )
+    parser.add_argument(
+        "--devices_per_worker", type=pos_int, default=1,
+        help="TPU chips visible to each worker host (mesh = workers x devices)",
+    )
+
+
+def build_master_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="elasticdl_tpu master", allow_abbrev=False)
+    add_common_arguments(parser)
+    add_model_zoo_arguments(parser)
+    add_data_arguments(parser)
+    add_train_arguments(parser)
+    add_cluster_arguments(parser)
+    parser.add_argument("--job_type", default="training_with_evaluation")
+    return parser
+
+
+def build_worker_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="elasticdl_tpu worker", allow_abbrev=False)
+    add_common_arguments(parser)
+    add_model_zoo_arguments(parser)
+    add_data_arguments(parser)
+    add_train_arguments(parser)
+    parser.add_argument("--worker_id", type=non_neg_int, required=True)
+    parser.add_argument("--master_addr", required=True)
+    parser.add_argument("--job_type", default="training_with_evaluation")
+    return parser
+
+
+def parse_master_args(argv=None):
+    args, unknown = build_master_parser().parse_known_args(argv)
+    _apply_log_level(args)
+    return args
+
+
+def parse_worker_args(argv=None):
+    args, unknown = build_worker_parser().parse_known_args(argv)
+    _apply_log_level(args)
+    return args
+
+
+def _apply_log_level(args):
+    from elasticdl_tpu.common.log_utils import set_default_level
+
+    set_default_level(args.log_level)
+
+
+def parse_dict_params(params: str) -> dict:
+    """Parse 'a=1,b=hello,c=0.5' into {'a': 1, 'b': 'hello', 'c': 0.5}."""
+    result = {}
+    if not params:
+        return result
+    for item in params.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(f"Malformed key=value pair: {item!r}")
+        key, value = item.split("=", 1)
+        for cast in (int, float):
+            try:
+                value = cast(value)
+                break
+            except ValueError:
+                continue
+        else:
+            if isinstance(value, str):
+                low = value.lower()
+                if low in ("true", "false"):
+                    value = low == "true"
+        result[key.strip()] = value
+    return result
+
+
+def args_to_argv(args: argparse.Namespace, keys=None) -> list:
+    """Round-trip a namespace back into --flag value argv (client -> pods)."""
+    argv = []
+    for key, value in sorted(vars(args).items()):
+        if keys is not None and key not in keys:
+            continue
+        if value is None or value == "":
+            continue
+        argv.extend([f"--{key}", str(value)])
+    return argv

@@ -1,0 +1,51 @@
+"""Framework-wide constants.
+
+Parity: elasticdl/python/common/constants.py in the reference.
+"""
+
+
+class DistributionStrategy:
+    LOCAL = "Local"
+    PARAMETER_SERVER = "ParameterServerStrategy"  # TPU: sharded-embedding data plane
+    ALLREDUCE = "AllreduceStrategy"  # TPU: psum over ICI
+
+
+class JobType:
+    TRAINING_ONLY = "training_only"
+    EVALUATION_ONLY = "evaluation_only"
+    PREDICTION_ONLY = "prediction_only"
+    TRAINING_WITH_EVALUATION = "training_with_evaluation"
+
+
+class TaskExecCounterKey:
+    BATCH_COUNT = "batch_count"
+    RECORD_COUNT = "record_count"
+
+
+class GRPC:
+    # The reference raises gRPC limits because its PS data plane rides
+    # protobuf; we keep generous limits for checkpoint/eval tensors.
+    MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
+    MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+    KEEPALIVE_TIME_MS = 30000
+    KEEPALIVE_TIMEOUT_MS = 10000
+
+
+class WorkerEnv:
+    MASTER_ADDR = "ELASTICDL_MASTER_ADDR"
+    WORKER_ID = "ELASTICDL_WORKER_ID"
+    WORKER_NUM = "ELASTICDL_WORKER_NUM"
+
+
+class DefaultTimeouts:
+    # Seconds a task may sit in `doing` before the master declares the
+    # worker slow/dead and recovers the task (0 disables).
+    TASK_TIMEOUT = 0
+    WORKER_HEARTBEAT_INTERVAL = 5
+    WORKER_LIVENESS_TIMEOUT = 30
+
+
+class Mode:
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"

@@ -1,0 +1,29 @@
+"""gRPC channel/server helpers with framework-wide options.
+
+Parity: elasticdl/python/common/grpc_utils.py in the reference (message size
+limits + keepalive so large checkpoint/eval tensors fit).
+"""
+
+from concurrent import futures
+
+import grpc
+
+from elasticdl_tpu.common.constants import GRPC
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+    ("grpc.keepalive_time_ms", GRPC.KEEPALIVE_TIME_MS),
+    ("grpc.keepalive_timeout_ms", GRPC.KEEPALIVE_TIMEOUT_MS),
+]
+
+
+def build_channel(addr: str) -> grpc.Channel:
+    return grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+
+
+def build_server(max_workers: int = 64) -> grpc.Server:
+    return grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=_CHANNEL_OPTIONS,
+    )

@@ -1,0 +1,43 @@
+"""Structured logging helpers.
+
+Parity: elasticdl/python/common/log_utils.py in the reference.
+"""
+
+import logging
+import sys
+
+_LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+_initialized = False
+
+
+def _init_root():
+    global _initialized
+    if _initialized:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_LOG_FORMAT))
+    root = logging.getLogger("elasticdl_tpu")
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    root.propagate = False
+    _initialized = True
+
+
+def get_logger(name: str, level=None) -> logging.Logger:
+    _init_root()
+    logger = logging.getLogger(f"elasticdl_tpu.{name}")
+    if level is not None:
+        logger.setLevel(level)
+    return logger
+
+
+def set_default_level(level):
+    """Apply --log_level to the whole framework (root elasticdl_tpu logger)."""
+    _init_root()
+    if isinstance(level, str):
+        level = level.upper()
+    logging.getLogger("elasticdl_tpu").setLevel(level)
+
+
+default_logger = get_logger("default")

@@ -1,0 +1,76 @@
+"""numpy/JAX array <-> proto Tensor conversion.
+
+Parity: the reference's tensor plumbing lives in
+elasticdl/python/common/tensor_utils.py (Python side) and
+elasticdl/pkg/common/tensor.go (Go side).  Here a single numpy-based codec
+serves both directions; JAX arrays convert via numpy (device_get).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+# ml_dtypes ships with jax and provides the bfloat16 numpy scalar type.
+import ml_dtypes
+
+_NP_TO_PB = {
+    np.dtype(np.float32): pb.DT_FLOAT32,
+    np.dtype(np.float64): pb.DT_FLOAT64,
+    np.dtype(np.int32): pb.DT_INT32,
+    np.dtype(np.int64): pb.DT_INT64,
+    np.dtype(np.bool_): pb.DT_BOOL,
+    np.dtype(ml_dtypes.bfloat16): pb.DT_BFLOAT16,
+    np.dtype(np.uint8): pb.DT_UINT8,
+    np.dtype(np.int8): pb.DT_INT8,
+    np.dtype(np.float16): pb.DT_FLOAT16,
+}
+
+_PB_TO_NP = {v: k for k, v in _NP_TO_PB.items()}
+
+
+def np_dtype_to_pb(dtype) -> int:
+    dtype = np.dtype(dtype)
+    if dtype not in _NP_TO_PB:
+        raise ValueError(f"Unsupported dtype for wire transfer: {dtype}")
+    return _NP_TO_PB[dtype]
+
+
+def pb_dtype_to_np(pb_dtype: int):
+    if pb_dtype not in _PB_TO_NP:
+        raise ValueError(f"Unsupported proto dtype: {pb_dtype}")
+    return _PB_TO_NP[pb_dtype]
+
+
+def ndarray_to_pb(array, name: str = "", indices=None) -> pb.Tensor:
+    """Serialize an array (numpy or JAX) into a proto Tensor.
+
+    `indices` non-None marks a sparse row-slice gradient (the reference's
+    IndexedSlices): `array` holds the rows, `indices` the row ids.
+    """
+    array = np.ascontiguousarray(np.asarray(array))
+    tensor = pb.Tensor(
+        name=name,
+        dims=list(array.shape),
+        content=array.tobytes(),
+        dtype=np_dtype_to_pb(array.dtype),
+    )
+    if indices is not None:
+        tensor.indices.extend(int(i) for i in np.asarray(indices).ravel())
+    return tensor
+
+
+def pb_to_ndarray(tensor: pb.Tensor) -> np.ndarray:
+    dtype = pb_dtype_to_np(tensor.dtype)
+    # Copy: frombuffer over proto bytes is read-only, and consumers apply
+    # in-place updates (e.g. optimizer apply on a restored parameter).
+    array = np.frombuffer(tensor.content, dtype=dtype).copy()
+    return array.reshape(tuple(tensor.dims))
+
+
+def pb_to_indexed_slices(tensor: pb.Tensor):
+    """Returns (values, indices) for a sparse row-slice tensor."""
+    values = pb_to_ndarray(tensor)
+    indices = np.asarray(tensor.indices, dtype=np.int64)
+    return values, indices

@@ -1,0 +1,115 @@
+"""Master gRPC service implementation.
+
+Parity: elasticdl/python/master/servicer.py in the reference — get_task /
+report_task_result / report_evaluation_metrics / report_version /
+get_comm_rank, plus (TPU rebuild) worker liveness heartbeats feeding the
+elastic rendezvous and shard-progress checkpoints for master resume.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.proto.service import MasterServicer as _Base
+
+logger = get_logger("master.servicer")
+
+
+class MasterServicer(_Base):
+    def __init__(
+        self,
+        task_manager,
+        evaluation_service=None,
+        rendezvous_server=None,
+        checkpoint_service=None,
+    ):
+        self._task_manager = task_manager
+        self._evaluation_service = evaluation_service
+        self._rendezvous_server = rendezvous_server
+        self._checkpoint_service = checkpoint_service
+        self._model_version = 0
+
+    # ------------------------------------------------------------------
+    # Task dispatch
+    # ------------------------------------------------------------------
+
+    def get_task(self, request, context):
+        task = self._task_manager.get(request.worker_id)
+        return pb.GetTaskResponse(task=task)
+
+    def report_task_result(self, request, context):
+        success = not request.err_message
+        self._task_manager.report(
+            request.task_id,
+            success,
+            worker_id=request.worker_id,
+            exec_counters=dict(request.exec_counters),
+        )
+        if not success:
+            logger.warning(
+                "Worker %d failed task %d: %s",
+                request.worker_id,
+                request.task_id,
+                request.err_message,
+            )
+        return pb.ReportTaskResultResponse()
+
+    # ------------------------------------------------------------------
+    # Metrics / versions
+    # ------------------------------------------------------------------
+
+    def report_evaluation_metrics(self, request, context):
+        if self._evaluation_service is not None:
+            self._evaluation_service.report_evaluation_metrics(
+                request.model_version, list(request.model_outputs), request.labels
+            )
+        return pb.ReportEvaluationMetricsResponse()
+
+    def report_version(self, request, context):
+        self._model_version = max(self._model_version, request.model_version)
+        if self._evaluation_service is not None:
+            self._evaluation_service.add_evaluation_task_if_needed(
+                self._model_version
+            )
+        if self._checkpoint_service is not None:
+            self._checkpoint_service.maybe_save(self._model_version)
+        return pb.ReportVersionResponse()
+
+    # ------------------------------------------------------------------
+    # Elastic membership
+    # ------------------------------------------------------------------
+
+    def get_comm_rank(self, request, context):
+        if self._rendezvous_server is None:
+            return pb.GetCommRankResponse(rank_id=0, world_size=1, rendezvous_id=0)
+        return self._rendezvous_server.get_comm_rank(request.worker_id)
+
+    def report_worker_liveness(self, request, context):
+        should_reset = False
+        if self._rendezvous_server is not None:
+            should_reset = self._rendezvous_server.report_liveness(
+                request.worker_id, request.host, request.rendezvous_id
+            )
+        return pb.ReportWorkerLivenessResponse(should_reset=should_reset)
+
+    # ------------------------------------------------------------------
+    # Master resume
+    # ------------------------------------------------------------------
+
+    def get_shard_checkpoint(self, request, context):
+        return pb.ShardCheckpointResponse(content=self._task_manager.to_checkpoint())
+
+
+def start_master_server(servicer: MasterServicer, port: int = 0):
+    """Start a gRPC server on `port` (0 picks a free one). Returns (server, port)."""
+    from elasticdl_tpu.common.grpc_utils import build_server
+    from elasticdl_tpu.proto.service import add_MasterServicer_to_server
+
+    server = build_server()
+    add_MasterServicer_to_server(servicer, server)
+    bound_port = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    logger.info("Master gRPC server listening on port %d", bound_port)
+    return server, bound_port

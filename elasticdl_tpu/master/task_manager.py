@@ -1,0 +1,349 @@
+"""Dynamic data sharding: the heart of elasticity.
+
+Parity: elasticdl/python/master/task_manager.py (older task_dispatcher.py) in
+the reference.  The dataset is split into shard-tasks `(shard_name, start,
+end, type)`; a `todo` deque holds unassigned tasks and a `doing` dict maps
+task_id -> (worker_id, task, start_time).  Tasks being worked by a dead or
+timed-out worker are recovered back to `todo` — at-least-once task semantics,
+so worker churn never loses data.
+
+TPU-specific notes: task ranges are the unit of *data* elasticity and are
+independent of the device mesh; a worker may run an N-chip mesh and consume
+tasks on behalf of all its chips.  Progress is JSON-serialisable so a
+restarted master resumes mid-epoch (see `to_checkpoint`/`from_checkpoint`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger("master.task_manager")
+
+
+@dataclass
+class _Task:
+    """In-memory task record (mirrors the proto Task)."""
+
+    shard_name: str
+    start: int
+    end: int
+    type: int
+    model_version: int = -1
+    epoch: int = 0
+    retry_count: int = 0
+
+    def to_proto(self, task_id: int) -> pb.Task:
+        return pb.Task(
+            task_id=task_id,
+            shard_name=self.shard_name,
+            start=self.start,
+            end=self.end,
+            type=self.type,
+            model_version=self.model_version,
+            epoch=self.epoch,
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "shard_name": self.shard_name,
+            "start": self.start,
+            "end": self.end,
+            "type": self.type,
+            "model_version": self.model_version,
+            "epoch": self.epoch,
+            "retry_count": self.retry_count,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "_Task":
+        return _Task(**obj)
+
+
+class TaskManager:
+    """Thread-safe dynamic shard-task dispatcher.
+
+    `training_shards` is a dict: shard_name -> number of records (or a
+    (start, count) tuple).  Each shard is cut into tasks of at most
+    `records_per_task` records; `num_epochs` epochs of training tasks are
+    generated lazily, one epoch at a time, so elastic re-planning (e.g. a
+    changed records_per_task on resume) only affects future epochs.
+    """
+
+    def __init__(
+        self,
+        training_shards: Optional[Dict[str, object]] = None,
+        evaluation_shards: Optional[Dict[str, object]] = None,
+        prediction_shards: Optional[Dict[str, object]] = None,
+        records_per_task: int = 4096,
+        num_epochs: int = 1,
+        task_timeout_s: float = 0.0,
+        max_task_retries: int = 3,
+    ):
+        self._lock = threading.Lock()
+        self._training_shards = dict(training_shards or {})
+        self._evaluation_shards = dict(evaluation_shards or {})
+        self._prediction_shards = dict(prediction_shards or {})
+        self._records_per_task = records_per_task
+        self._num_epochs = num_epochs
+        self._task_timeout_s = task_timeout_s
+        self._max_task_retries = max_task_retries
+
+        self._todo: deque = deque()
+        self._doing: Dict[int, Tuple[int, _Task, float]] = {}
+        self._task_id = 0
+        self._epoch = 0
+        self._finished_record_count = 0
+        # Aggregated exec counters reported by workers (e.g. batch_count).
+        self._exec_counters: Dict[str, int] = {}
+        # Tasks dropped after exhausting their retry budget.
+        self._permanently_failed: List[_Task] = []
+        self._tasks_done_callbacks: List[Callable[[], None]] = []
+        self._done_callbacks_fired = False
+
+        if self._training_shards:
+            self._create_training_tasks_locked()
+        elif self._prediction_shards:
+            self._create_tasks_locked(self._prediction_shards, pb.PREDICTION)
+
+    # ------------------------------------------------------------------
+    # Task creation
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _shard_ranges(shards: Dict[str, object]):
+        for name, spec in shards.items():
+            if isinstance(spec, (tuple, list)):
+                start, count = spec
+            else:
+                start, count = 0, int(spec)
+            yield name, int(start), int(count)
+
+    def _create_tasks_locked(self, shards, task_type, model_version=-1):
+        count = 0
+        for name, start, num_records in self._shard_ranges(shards):
+            for lo in range(start, start + num_records, self._records_per_task):
+                hi = min(lo + self._records_per_task, start + num_records)
+                self._todo.append(
+                    _Task(
+                        shard_name=name,
+                        start=lo,
+                        end=hi,
+                        type=task_type,
+                        model_version=model_version,
+                        epoch=self._epoch,
+                    )
+                )
+                count += 1
+        logger.info(
+            "Created %d %s tasks (epoch %d)",
+            count,
+            pb.TaskType.Name(task_type),
+            self._epoch,
+        )
+        return count
+
+    def _create_training_tasks_locked(self):
+        return self._create_tasks_locked(self._training_shards, pb.TRAINING)
+
+    def create_evaluation_tasks(self, model_version: int) -> int:
+        """Interleave evaluation tasks at the front of the queue."""
+        with self._lock:
+            count = 0
+            tasks = []
+            for name, start, num_records in self._shard_ranges(self._evaluation_shards):
+                for lo in range(start, start + num_records, self._records_per_task):
+                    hi = min(lo + self._records_per_task, start + num_records)
+                    tasks.append(
+                        _Task(name, lo, hi, pb.EVALUATION, model_version, self._epoch)
+                    )
+                    count += 1
+            self._todo.extendleft(reversed(tasks))
+            logger.info(
+                "Created %d EVALUATION tasks at model version %d", count, model_version
+            )
+            return count
+
+    # ------------------------------------------------------------------
+    # Dispatch protocol
+    # ------------------------------------------------------------------
+
+    def get(self, worker_id: int) -> pb.Task:
+        """Pop the next task for `worker_id`.
+
+        Returns a WAIT task when the queue is momentarily empty but work is
+        still outstanding (`doing` non-empty or epochs remain), and a task
+        with task_id == -1 when the job is complete.
+        """
+        with self._lock:
+            self._recover_timed_out_locked()
+            if not self._todo and not self._doing:
+                # Current epoch fully finished: advance or end.
+                if self._epoch + 1 < self._num_epochs and self._training_shards:
+                    self._epoch += 1
+                    self._create_training_tasks_locked()
+                else:
+                    return pb.Task(task_id=-1)
+            if not self._todo:
+                return pb.Task(task_id=-1, type=pb.WAIT)
+
+            task = self._todo.popleft()
+            self._task_id += 1
+            task_id = self._task_id
+            self._doing[task_id] = (worker_id, task, time.time())
+            return task.to_proto(task_id)
+
+    def report(self, task_id: int, success: bool, worker_id: int = -1,
+               exec_counters: Optional[Dict[str, int]] = None) -> bool:
+        """Mark a task done/failed. Failed tasks go back to `todo`.
+
+        Returns True if the task_id was a known in-flight task.
+        """
+        callbacks_to_run = []
+        with self._lock:
+            entry = self._doing.pop(task_id, None)
+            if entry is None:
+                logger.warning("Report for unknown/expired task %d", task_id)
+                return False
+            owner, task, _start = entry
+            if success:
+                if task.type == pb.TRAINING:
+                    self._finished_record_count += task.end - task.start
+                for key, value in (exec_counters or {}).items():
+                    self._exec_counters[key] = self._exec_counters.get(key, 0) + value
+            elif task.retry_count + 1 > self._max_task_retries:
+                logger.error(
+                    "Task %d (%s[%d,%d)) exhausted %d retries; dropping",
+                    task_id, task.shard_name, task.start, task.end,
+                    self._max_task_retries,
+                )
+                self._permanently_failed.append(task)
+            else:
+                task.retry_count += 1
+                logger.info(
+                    "Task %d failed; requeueing (retry %d/%d)",
+                    task_id, task.retry_count, self._max_task_retries,
+                )
+                self._todo.appendleft(task)
+            if not self._todo and not self._doing and not self._done_callbacks_fired:
+                if self._epoch + 1 >= self._num_epochs or not self._training_shards:
+                    self._done_callbacks_fired = True
+                    callbacks_to_run = list(self._tasks_done_callbacks)
+        # Run outside the lock: callbacks may legitimately call back into
+        # the TaskManager API (e.g. to_checkpoint at end of job).
+        for callback in callbacks_to_run:
+            try:
+                callback()
+            except Exception:
+                logger.exception("tasks-done callback failed")
+        return True
+
+    def recover_tasks(self, worker_id: int) -> int:
+        """Requeue all tasks in-flight on a dead/removed worker."""
+        with self._lock:
+            recovered = [
+                tid for tid, (owner, _t, _s) in self._doing.items() if owner == worker_id
+            ]
+            for tid in recovered:
+                _owner, task, _start = self._doing.pop(tid)
+                self._todo.appendleft(task)
+            if recovered:
+                logger.info(
+                    "Recovered %d tasks from worker %d", len(recovered), worker_id
+                )
+            return len(recovered)
+
+    def _recover_timed_out_locked(self):
+        if not self._task_timeout_s:
+            return
+        now = time.time()
+        expired = [
+            tid
+            for tid, (_owner, _task, start) in self._doing.items()
+            if now - start > self._task_timeout_s
+        ]
+        for tid in expired:
+            owner, task, _start = self._doing.pop(tid)
+            self._todo.appendleft(task)
+            logger.info("Task %d timed out on worker %d; requeued", tid, owner)
+
+    # ------------------------------------------------------------------
+    # Introspection / lifecycle
+    # ------------------------------------------------------------------
+
+    def add_tasks_done_callback(self, callback: Callable[[], None]):
+        with self._lock:
+            self._tasks_done_callbacks.append(callback)
+
+    def finished(self) -> bool:
+        with self._lock:
+            return (
+                not self._todo
+                and not self._doing
+                and (self._epoch + 1 >= self._num_epochs or not self._training_shards)
+            )
+
+    @property
+    def finished_record_count(self) -> int:
+        with self._lock:
+            return self._finished_record_count
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "todo": len(self._todo),
+                "doing": len(self._doing),
+                "epoch": self._epoch,
+            }
+
+    def exec_counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._exec_counters)
+
+    def permanently_failed_tasks(self) -> List[pb.Task]:
+        with self._lock:
+            return [t.to_proto(-1) for t in self._permanently_failed]
+
+    # ------------------------------------------------------------------
+    # Master resume: shard-progress checkpoint
+    # ------------------------------------------------------------------
+
+    def to_checkpoint(self) -> str:
+        """JSON snapshot; `doing` tasks are treated as todo (at-least-once)."""
+        with self._lock:
+            todo = [t.to_json() for t in self._todo]
+            todo.extend(t.to_json() for (_w, t, _s) in self._doing.values())
+            return json.dumps(
+                {
+                    "epoch": self._epoch,
+                    "num_epochs": self._num_epochs,
+                    "records_per_task": self._records_per_task,
+                    "finished_record_count": self._finished_record_count,
+                    "training_shards": self._training_shards,
+                    "evaluation_shards": self._evaluation_shards,
+                    "todo": todo,
+                }
+            )
+
+    @classmethod
+    def from_checkpoint(cls, content: str, task_timeout_s: float = 0.0) -> "TaskManager":
+        state = json.loads(content)
+        manager = cls(
+            training_shards=None,
+            evaluation_shards=state.get("evaluation_shards") or {},
+            records_per_task=state["records_per_task"],
+            num_epochs=state["num_epochs"],
+            task_timeout_s=task_timeout_s,
+        )
+        manager._training_shards = state.get("training_shards") or {}
+        manager._epoch = state["epoch"]
+        manager._finished_record_count = state.get("finished_record_count", 0)
+        manager._todo.extend(_Task.from_json(t) for t in state["todo"])
+        return manager

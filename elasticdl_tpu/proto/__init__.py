@@ -1,0 +1,13 @@
+from elasticdl_tpu.proto import elasticdl_pb2
+from elasticdl_tpu.proto.service import (
+    MasterServicer,
+    MasterStub,
+    add_MasterServicer_to_server,
+)
+
+__all__ = [
+    "elasticdl_pb2",
+    "MasterServicer",
+    "MasterStub",
+    "add_MasterServicer_to_server",
+]

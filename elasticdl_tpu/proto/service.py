@@ -1,0 +1,75 @@
+"""Hand-written gRPC wiring for the `Master` service.
+
+The environment ships `protoc` (message codegen) but not the gRPC protoc
+plugin, so the stub/servicer glue that `elasticdl_pb2_grpc.py` would contain
+in the reference (generated from elasticdl/proto/elasticdl.proto) is written
+by hand here.  It is equivalent in shape: a `MasterServicer` base class, a
+`MasterStub` client, and `add_MasterServicer_to_server`.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+_SERVICE_NAME = "elasticdl_tpu.Master"
+
+# method name -> (request class, response class)
+_METHODS = {
+    "get_task": (pb.GetTaskRequest, pb.GetTaskResponse),
+    "report_task_result": (pb.ReportTaskResultRequest, pb.ReportTaskResultResponse),
+    "report_evaluation_metrics": (
+        pb.ReportEvaluationMetricsRequest,
+        pb.ReportEvaluationMetricsResponse,
+    ),
+    "report_version": (pb.ReportVersionRequest, pb.ReportVersionResponse),
+    "get_comm_rank": (pb.GetCommRankRequest, pb.GetCommRankResponse),
+    "report_worker_liveness": (
+        pb.ReportWorkerLivenessRequest,
+        pb.ReportWorkerLivenessResponse,
+    ),
+    "get_shard_checkpoint": (pb.ShardCheckpointRequest, pb.ShardCheckpointResponse),
+}
+
+
+class MasterServicer:
+    """Base class; override each method. Unimplemented methods return UNIMPLEMENTED."""
+
+    def _unimplemented(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details("Method not implemented")
+        raise NotImplementedError("Method not implemented")
+
+
+for _name in _METHODS:
+    setattr(MasterServicer, _name, MasterServicer._unimplemented)
+
+
+def add_MasterServicer_to_server(servicer, server):
+    handlers = {}
+    for name, (req_cls, resp_cls) in _METHODS.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_SERVICE_NAME, handlers),)
+    )
+
+
+class MasterStub:
+    """Client stub for the Master service."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (req_cls, resp_cls) in _METHODS.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{_SERVICE_NAME}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )

@@ -1,0 +1,76 @@
+"""Worker-side wrapper around the Master gRPC stub.
+
+Parity: elasticdl/python/worker/master_client.py in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.grpc_utils import build_channel
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.proto.service import MasterStub
+
+
+class MasterClient:
+    def __init__(self, addr: str, worker_id: int):
+        self._channel = build_channel(addr)
+        self._stub = MasterStub(self._channel)
+        self._worker_id = worker_id
+
+    @property
+    def worker_id(self) -> int:
+        return self._worker_id
+
+    def get_task(self, task_type: int = pb.TRAINING) -> pb.Task:
+        request = pb.GetTaskRequest(worker_id=self._worker_id, task_type=task_type)
+        return self._stub.get_task(request).task
+
+    def report_task_result(
+        self, task_id: int, err_message: str = "", exec_counters: Optional[Dict[str, int]] = None
+    ):
+        request = pb.ReportTaskResultRequest(
+            task_id=task_id, err_message=err_message, worker_id=self._worker_id
+        )
+        if exec_counters:
+            for key, value in exec_counters.items():
+                request.exec_counters[key] = int(value)
+        self._stub.report_task_result(request)
+
+    def report_evaluation_metrics(self, model_version: int, model_outputs, labels):
+        request = pb.ReportEvaluationMetricsRequest(
+            worker_id=self._worker_id, model_version=model_version
+        )
+        for name, array in model_outputs.items():
+            request.model_outputs.append(tensor_utils.ndarray_to_pb(array, name=name))
+        request.labels.CopyFrom(tensor_utils.ndarray_to_pb(np.asarray(labels)))
+        self._stub.report_evaluation_metrics(request)
+
+    def report_version(self, model_version: int):
+        self._stub.report_version(
+            pb.ReportVersionRequest(
+                model_version=model_version, worker_id=self._worker_id
+            )
+        )
+
+    def get_comm_rank(self) -> pb.GetCommRankResponse:
+        return self._stub.get_comm_rank(
+            pb.GetCommRankRequest(worker_id=self._worker_id)
+        )
+
+    def report_worker_liveness(self, host: str, rendezvous_id: int) -> bool:
+        response = self._stub.report_worker_liveness(
+            pb.ReportWorkerLivenessRequest(
+                worker_id=self._worker_id, host=host, rendezvous_id=rendezvous_id
+            )
+        )
+        return response.should_reset
+
+    def get_shard_checkpoint(self) -> str:
+        return self._stub.get_shard_checkpoint(pb.ShardCheckpointRequest()).content
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Emulates an 8-chip TPU slice on CPU (SURVEY.md §4: the fake-device layer) so
+pjit/shard_map/psum and mesh re-formation logic are exercised without
+hardware.  Must run before the first `import jax` anywhere in the test
+process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep XLA compilation single-threaded-friendly on the 1-core CI host.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")

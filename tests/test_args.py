@@ -1,0 +1,47 @@
+"""Flag system tests (parity: args_test.py in the reference)."""
+
+import pytest
+
+from elasticdl_tpu.common import args as args_mod
+
+
+def test_master_parser_minimal():
+    args = args_mod.parse_master_args(
+        ["--model_zoo", "model_zoo", "--model_def", "mnist.mnist_functional_api"]
+    )
+    assert args.distribution_strategy == "Local"
+    assert args.num_workers == 1
+    assert args.records_per_task == 4096
+
+
+def test_unknown_flags_tolerated():
+    args = args_mod.parse_master_args(
+        [
+            "--model_zoo", "z", "--model_def", "m",
+            "--totally_unknown_flag", "42",
+        ]
+    )
+    assert args.model_def == "m"
+
+
+def test_worker_parser_requires_identity():
+    with pytest.raises(SystemExit):
+        args_mod.parse_worker_args(["--model_zoo", "z", "--model_def", "m"])
+
+
+def test_parse_dict_params():
+    params = args_mod.parse_dict_params("lr=0.1,hidden=128,name=mlp,flag=true")
+    assert params == {"lr": 0.1, "hidden": 128, "name": "mlp", "flag": True}
+    assert args_mod.parse_dict_params("") == {}
+    with pytest.raises(ValueError):
+        args_mod.parse_dict_params("oops")
+
+
+def test_args_roundtrip_to_argv():
+    args = args_mod.parse_master_args(
+        ["--model_zoo", "z", "--model_def", "m", "--num_workers", "4"]
+    )
+    argv = args_mod.args_to_argv(args)
+    again = args_mod.parse_master_args(argv)
+    assert again.num_workers == 4
+    assert again.model_def == "m"

@@ -1,0 +1,266 @@
+"""Task manager unit tests.
+
+Parity surface: elasticdl/python/tests/task_manager_test.py in the reference
+(shard creation, get/report/recover semantics, epoch boundaries).
+"""
+
+import threading
+
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+def make_manager(**kwargs):
+    defaults = dict(
+        training_shards={"f1": 30, "f2": 15},
+        records_per_task=10,
+        num_epochs=1,
+    )
+    defaults.update(kwargs)
+    return TaskManager(**defaults)
+
+
+def drain(manager, worker_id=0, succeed=True):
+    tasks = []
+    while True:
+        task = manager.get(worker_id)
+        if task.task_id == -1 and task.type != pb.WAIT:
+            break
+        if task.type == pb.WAIT:
+            break
+        tasks.append(task)
+        manager.report(task.task_id, succeed, worker_id)
+    return tasks
+
+
+class TestShardCreation:
+    def test_task_count_and_ranges(self):
+        manager = make_manager()
+        tasks = drain(manager)
+        # f1: [0,10),[10,20),[20,30); f2: [0,10),[10,15)
+        assert len(tasks) == 5
+        ranges = sorted((t.shard_name, t.start, t.end) for t in tasks)
+        assert ranges == [
+            ("f1", 0, 10),
+            ("f1", 10, 20),
+            ("f1", 20, 30),
+            ("f2", 0, 10),
+            ("f2", 10, 15),
+        ]
+
+    def test_uneven_tail_shard(self):
+        manager = TaskManager(training_shards={"x": 7}, records_per_task=3)
+        tasks = drain(manager)
+        assert [(t.start, t.end) for t in tasks] == [(0, 3), (3, 6), (6, 7)]
+
+    def test_shard_with_offset(self):
+        manager = TaskManager(training_shards={"x": (100, 5)}, records_per_task=10)
+        tasks = drain(manager)
+        assert [(t.start, t.end) for t in tasks] == [(100, 105)]
+
+
+class TestDispatchSemantics:
+    def test_task_ids_unique_and_positive(self):
+        manager = make_manager()
+        seen = set()
+        task = manager.get(0)
+        while task.task_id != -1:
+            assert task.task_id not in seen
+            seen.add(task.task_id)
+            manager.report(task.task_id, True, 0)
+            task = manager.get(0)
+        assert len(seen) == 5
+
+    def test_wait_while_tasks_in_flight(self):
+        manager = TaskManager(training_shards={"x": 10}, records_per_task=10)
+        task = manager.get(0)
+        assert task.task_id > 0
+        # Queue empty but task in flight: second worker told to WAIT.
+        waiting = manager.get(1)
+        assert waiting.type == pb.WAIT and waiting.task_id == -1
+        manager.report(task.task_id, True, 0)
+        done = manager.get(1)
+        assert done.task_id == -1 and done.type != pb.WAIT
+
+    def test_failed_task_requeued(self):
+        manager = TaskManager(training_shards={"x": 10}, records_per_task=10)
+        task = manager.get(0)
+        manager.report(task.task_id, False, 0)
+        retry = manager.get(1)
+        assert (retry.shard_name, retry.start, retry.end) == ("x", 0, 10)
+        assert retry.task_id != task.task_id
+
+    def test_report_unknown_task(self):
+        manager = make_manager()
+        assert manager.report(9999, True, 0) is False
+
+    def test_finished_record_count(self):
+        manager = make_manager()
+        drain(manager)
+        assert manager.finished_record_count == 45
+
+
+class TestRecovery:
+    def test_recover_tasks_of_dead_worker(self):
+        manager = TaskManager(training_shards={"x": 30}, records_per_task=10)
+        t0 = manager.get(0)
+        t1 = manager.get(0)
+        t2 = manager.get(1)
+        assert manager.counts()["doing"] == 3
+        recovered = manager.recover_tasks(0)
+        assert recovered == 2
+        # Worker 1 finishes everything, including the recovered ranges.
+        manager.report(t2.task_id, True, 1)
+        remaining = drain(manager, worker_id=1)
+        got = sorted((t.start, t.end) for t in remaining)
+        assert got == sorted([(t0.start, t0.end), (t1.start, t1.end)])
+        assert manager.finished()
+
+    def test_task_timeout_recovery(self):
+        manager = TaskManager(
+            training_shards={"x": 10}, records_per_task=10, task_timeout_s=0.001
+        )
+        stale = manager.get(0)
+        import time
+
+        time.sleep(0.01)
+        # Next get() sweeps the timed-out task back and hands it over.
+        fresh = manager.get(1)
+        assert (fresh.start, fresh.end) == (stale.start, stale.end)
+        # The stale report is now a no-op.
+        assert manager.report(stale.task_id, True, 0) is False
+
+
+class TestEpochs:
+    def test_multi_epoch_generation(self):
+        manager = TaskManager(
+            training_shards={"x": 20}, records_per_task=10, num_epochs=3
+        )
+        epochs = []
+        task = manager.get(0)
+        while task.task_id != -1:
+            epochs.append(task.epoch)
+            manager.report(task.task_id, True, 0)
+            task = manager.get(0)
+        assert epochs == [0, 0, 1, 1, 2, 2]
+        assert manager.finished()
+
+    def test_done_callback_fires_once_at_end(self):
+        fired = []
+        manager = TaskManager(
+            training_shards={"x": 20}, records_per_task=10, num_epochs=2
+        )
+        manager.add_tasks_done_callback(lambda: fired.append(1))
+        drain_all(manager)
+        assert fired == [1]
+
+
+def drain_all(manager):
+    task = manager.get(0)
+    while task.task_id != -1 or task.type == pb.WAIT:
+        if task.task_id != -1:
+            manager.report(task.task_id, True, 0)
+        task = manager.get(0)
+
+
+class TestEvaluationTasks:
+    def test_eval_tasks_interleave_at_front(self):
+        manager = TaskManager(
+            training_shards={"x": 20},
+            evaluation_shards={"v": 10},
+            records_per_task=10,
+        )
+        count = manager.create_evaluation_tasks(model_version=7)
+        assert count == 1
+        task = manager.get(0)
+        assert task.type == pb.EVALUATION
+        assert task.model_version == 7
+        assert task.shard_name == "v"
+
+
+class TestCheckpoint:
+    def test_roundtrip_mid_epoch(self):
+        manager = TaskManager(
+            training_shards={"x": 40}, records_per_task=10, num_epochs=2
+        )
+        t = manager.get(0)
+        manager.report(t.task_id, True, 0)
+        in_flight = manager.get(0)  # left in doing: must reappear after resume
+
+        resumed = TaskManager.from_checkpoint(manager.to_checkpoint())
+        ranges = [(task.start, task.end) for task in drain_all_collect(resumed)]
+        # 3 remaining tasks of epoch 0 (incl. the in-flight one) + 4 of epoch 1
+        assert len(ranges) == 7
+        assert (in_flight.start, in_flight.end) in ranges
+
+    def test_concurrent_get_report(self):
+        manager = TaskManager(training_shards={"x": 1000}, records_per_task=10)
+        errors = []
+
+        def run(worker_id):
+            try:
+                while True:
+                    task = manager.get(worker_id)
+                    if task.task_id == -1 and task.type != pb.WAIT:
+                        return
+                    if task.task_id != -1:
+                        manager.report(task.task_id, True, worker_id)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        assert not errors
+        assert manager.finished()
+        assert manager.finished_record_count == 1000
+
+
+def drain_all_collect(manager):
+    tasks = []
+    task = manager.get(0)
+    while task.task_id != -1 or task.type == pb.WAIT:
+        if task.task_id != -1:
+            tasks.append(task)
+            manager.report(task.task_id, True, 0)
+        task = manager.get(0)
+    return tasks
+
+
+class TestRetryBudget:
+    def test_poison_task_dropped_after_max_retries(self):
+        manager = TaskManager(
+            training_shards={"x": 20}, records_per_task=10, max_task_retries=2
+        )
+        # Fail the same range 3 times: 2 retries allowed, then dropped.
+        for _ in range(3):
+            task = manager.get(0)
+            assert (task.start, task.end) == (0, 10)
+            manager.report(task.task_id, False, 0)
+        failed = manager.permanently_failed_tasks()
+        assert len(failed) == 1
+        assert (failed[0].start, failed[0].end) == (0, 10)
+        # The job still completes with the remaining range.
+        rest = manager.get(0)
+        assert (rest.start, rest.end) == (10, 20)
+        manager.report(rest.task_id, True, 0)
+        assert manager.finished()
+
+    def test_callback_may_reenter_task_manager(self):
+        manager = TaskManager(training_shards={"x": 10}, records_per_task=10)
+        seen = []
+        manager.add_tasks_done_callback(
+            lambda: seen.append(manager.to_checkpoint())
+        )
+        task = manager.get(0)
+        manager.report(task.task_id, True, 0)  # must not deadlock
+        assert len(seen) == 1
+
+    def test_exec_counters_aggregate(self):
+        manager = TaskManager(training_shards={"x": 20}, records_per_task=10)
+        for _ in range(2):
+            task = manager.get(0)
+            manager.report(task.task_id, True, 0, exec_counters={"batch_count": 5})
+        assert manager.exec_counters() == {"batch_count": 10}

@@ -1,0 +1,53 @@
+"""Tensor codec tests (numpy/JAX <-> proto)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+@pytest.mark.parametrize(
+    "dtype", [np.float32, np.float64, np.int32, np.int64, np.bool_, np.float16]
+)
+def test_roundtrip_dtypes(dtype):
+    rng = np.random.default_rng(0)
+    array = rng.standard_normal((3, 4)).astype(dtype)
+    tensor = tensor_utils.ndarray_to_pb(array, name="w")
+    out = tensor_utils.pb_to_ndarray(tensor)
+    assert out.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(out, array)
+    assert tensor.name == "w"
+
+
+def test_bfloat16_roundtrip():
+    import ml_dtypes
+
+    array = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(2, 4)
+    out = tensor_utils.pb_to_ndarray(tensor_utils.ndarray_to_pb(array))
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out, array)
+
+
+def test_jax_array_roundtrip():
+    import jax.numpy as jnp
+
+    array = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    out = tensor_utils.pb_to_ndarray(tensor_utils.ndarray_to_pb(array))
+    np.testing.assert_allclose(out, np.asarray(array))
+
+
+def test_indexed_slices_roundtrip():
+    values = np.ones((2, 8), dtype=np.float32)
+    indices = np.array([3, 17], dtype=np.int64)
+    tensor = tensor_utils.ndarray_to_pb(values, name="emb", indices=indices)
+    out_values, out_indices = tensor_utils.pb_to_indexed_slices(tensor)
+    np.testing.assert_array_equal(out_values, values)
+    np.testing.assert_array_equal(out_indices, indices)
+
+
+def test_unsupported_dtype_raises():
+    with pytest.raises(ValueError):
+        tensor_utils.np_dtype_to_pb(np.complex64)
+    with pytest.raises(ValueError):
+        tensor_utils.pb_dtype_to_np(pb.DT_INVALID)
