@@ -1,0 +1,71 @@
+"""Benchmark entrypoint: prints ONE JSON line with the headline metric.
+
+Runs on whatever accelerator is visible (the driver provides one real TPU
+chip).  Headline: flagship-model training throughput in samples/sec/chip.
+The reference publishes no numbers (BASELINE.md), so vs_baseline compares
+against this framework's own recorded round-1 target.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+# Self-established target (samples/sec/chip) to compare across rounds; see
+# BASELINE.md — the reference publishes no benchmark numbers.
+SELF_BASELINE = {"mnist_dnn_train_samples_per_sec_per_chip": 13_800_000.0}
+
+
+def bench_mnist_dnn(batch_size: int = 1024, steps: int = 50):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from model_zoo.mnist import mnist_functional_api as zoo
+
+    model = zoo.custom_model()
+    tx = zoo.optimizer()
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (batch_size, 28, 28), jnp.float32)
+    labels = jax.random.randint(rng, (batch_size,), 0, 10, jnp.int32)
+    params = model.init(rng, images)["params"]
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, images, labels):
+        def compute_loss(p):
+            return zoo.loss(labels, model.apply({"params": p}, images))
+
+        loss, grads = jax.value_and_grad(compute_loss)(params)
+        updates, opt_state2 = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    # Warmup/compile.
+    params, opt_state, loss = train_step(params, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    return batch_size * steps / elapsed
+
+
+def main():
+    samples_per_sec = bench_mnist_dnn()
+    metric = "mnist_dnn_train_samples_per_sec_per_chip"
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(samples_per_sec / SELF_BASELINE[metric], 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

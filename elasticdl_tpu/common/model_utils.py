@@ -1,0 +1,78 @@
+"""Model-zoo module loading.
+
+Parity: elasticdl/python/common/model_utils.py in the reference — dynamic
+import of the user's model module by zoo path + dotted module name, and
+resolution of the contract functions (custom_model / loss / optimizer /
+dataset_fn / eval_metrics_fn / callbacks / custom_data_reader).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from elasticdl_tpu.common.args import parse_dict_params
+
+
+@dataclass
+class ModelSpec:
+    module: Any
+    custom_model: Callable
+    loss: Callable
+    optimizer: Callable
+    dataset_fn: Callable
+    eval_metrics_fn: Optional[Callable] = None
+    callbacks: Optional[Callable] = None
+    custom_data_reader: Optional[Callable] = None
+    model_params: dict = field(default_factory=dict)
+
+    def build_model(self):
+        return self.custom_model(**self.model_params)
+
+
+def load_module(model_zoo: str, model_def: str):
+    """Import `model_def` (dotted module path) from the `model_zoo` directory.
+
+    `model_zoo` may be a directory (added to sys.path, reference behavior)
+    or an importable package name.
+    """
+    if os.path.isdir(model_zoo):
+        parent = os.path.abspath(os.path.join(model_zoo, os.pardir))
+        if parent not in sys.path:
+            sys.path.insert(0, parent)
+        zoo_package = os.path.basename(os.path.normpath(model_zoo))
+        module_name = f"{zoo_package}.{model_def}"
+    else:
+        module_name = f"{model_zoo}.{model_def}" if model_zoo else model_def
+    return importlib.import_module(module_name)
+
+
+def load_model_spec(args) -> ModelSpec:
+    """Resolve the model-zoo contract from parsed args."""
+    module = load_module(args.model_zoo, args.model_def)
+
+    def require(name):
+        fn = getattr(module, name, None)
+        if fn is None:
+            raise ValueError(
+                f"Model module {args.model_def!r} must define {name}()"
+            )
+        return fn
+
+    def optional(name):
+        return getattr(module, name, None) if name else None
+
+    return ModelSpec(
+        module=module,
+        custom_model=require("custom_model"),
+        loss=require(args.loss),
+        optimizer=require(args.optimizer),
+        dataset_fn=require(args.dataset_fn),
+        eval_metrics_fn=optional(args.eval_metrics_fn),
+        callbacks=optional(args.callbacks),
+        custom_data_reader=optional(args.custom_data_reader),
+        model_params=parse_dict_params(args.model_params),
+    )

@@ -1,0 +1,109 @@
+"""A minimal, TF-free host-side dataset pipeline.
+
+The reference's model-zoo contract passes a `tf.data.Dataset` through the
+user's `dataset_fn` (elasticdl/python/data/ in the reference).  The TPU
+rebuild keeps the same call shape — `dataset_fn(dataset, mode, metadata)`
+returning a transformed dataset — but the pipeline is a small numpy-based
+iterator chain: records stream from the data reader on the host CPU, are
+parsed/shuffled/batched here, and land on device as whole batches (one
+host->HBM transfer per step, the TPU-friendly feed pattern).
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+class Dataset:
+    """Lazy record pipeline: from_generator -> map -> shuffle -> batch."""
+
+    def __init__(self, source: Callable[[], Iterator]):
+        # `source` is a zero-arg callable returning a fresh iterator so the
+        # dataset can be re-iterated (e.g. retry of a failed task).
+        self._source = source
+
+    @staticmethod
+    def from_generator(generator_fn: Callable[[], Iterator]) -> "Dataset":
+        return Dataset(generator_fn)
+
+    @staticmethod
+    def from_iterable(iterable: Iterable) -> "Dataset":
+        materialized = list(iterable) if not isinstance(iterable, (list, tuple)) else iterable
+        return Dataset(lambda: iter(materialized))
+
+    def map(self, fn: Callable) -> "Dataset":
+        source = self._source
+
+        def mapped():
+            for record in source():
+                yield fn(record)
+
+        return Dataset(mapped)
+
+    def filter(self, predicate: Callable) -> "Dataset":
+        source = self._source
+
+        def filtered():
+            for record in source():
+                if predicate(record):
+                    yield record
+
+        return Dataset(filtered)
+
+    def shuffle(self, buffer_size: int, seed: Optional[int] = None) -> "Dataset":
+        source = self._source
+
+        def shuffled():
+            rng = random.Random(seed)
+            buffer = []
+            for record in source():
+                buffer.append(record)
+                if len(buffer) >= buffer_size:
+                    index = rng.randrange(len(buffer))
+                    buffer[index], buffer[-1] = buffer[-1], buffer[index]
+                    yield buffer.pop()
+            rng.shuffle(buffer)
+            yield from buffer
+
+        return Dataset(shuffled)
+
+    def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":
+        source = self._source
+
+        def batched():
+            batch = []
+            for record in source():
+                batch.append(record)
+                if len(batch) == batch_size:
+                    yield _stack(batch)
+                    batch = []
+            if batch and not drop_remainder:
+                yield _stack(batch)
+
+        return Dataset(batched)
+
+    def repeat(self, count: int) -> "Dataset":
+        source = self._source
+
+        def repeated():
+            for _ in range(count):
+                yield from source()
+
+        return Dataset(repeated)
+
+    def __iter__(self):
+        return self._source()
+
+
+def _stack(records):
+    """Stack a list of examples into a batch, handling nested structures."""
+    first = records[0]
+    if isinstance(first, tuple):
+        return tuple(_stack([r[i] for r in records]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: _stack([r[k] for r in records]) for k in first}
+    return np.stack([np.asarray(r) for r in records])

@@ -1,0 +1,26 @@
+"""Build the per-task dataset a worker trains on.
+
+Parity: elasticdl/python/data/task_data_service.py in the reference — turns
+the current task's record range into the user-visible dataset by streaming
+reader records through the user's dataset_fn.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.data.dataset import Dataset
+
+
+class TaskDataService:
+    def __init__(self, data_reader, dataset_fn, metadata=None):
+        self._reader = data_reader
+        self._dataset_fn = dataset_fn
+        self._metadata = metadata if metadata is not None else data_reader.metadata
+
+    def get_dataset(self, task, mode: str) -> Dataset:
+        reader = self._reader
+
+        def records():
+            return reader.read_records(task)
+
+        dataset = Dataset.from_generator(records)
+        return self._dataset_fn(dataset, mode, self._metadata)

@@ -1,0 +1,136 @@
+"""Master-side evaluation: schedules eval rounds, aggregates metrics.
+
+Parity: elasticdl/python/master/evaluation_service.py in the reference —
+interleaves EVALUATION tasks at `--evaluation_steps` intervals (or per
+epoch when 0) and computes the user's eval metrics on worker-reported
+(model_outputs, labels).  Metrics for a round are computed once, when all
+of the round's tasks have reported, and the raw batches are then dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("master.evaluation_service")
+
+
+class EvaluationService:
+    def __init__(
+        self,
+        task_manager,
+        eval_metrics_fn=None,
+        evaluation_steps: int = 0,
+        tensorboard_service=None,
+    ):
+        self._task_manager = task_manager
+        self._eval_metrics_fn = eval_metrics_fn
+        self._evaluation_steps = evaluation_steps
+        self._tensorboard_service = tensorboard_service
+        self._lock = threading.Lock()
+        self._last_eval_version = -1
+        # Per in-flight round (keyed by model_version):
+        self._reported: Dict[int, List] = {}   # list of (outputs dict, labels)
+        self._expected_reports: Dict[int, int] = {}
+        self._report_counts: Dict[int, int] = {}
+        self._latest_metrics: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    # Scheduling
+    # ------------------------------------------------------------------
+
+    def add_evaluation_task_if_needed(self, model_version: int):
+        """Step-interval scheduling (no-op when evaluation_steps == 0; the
+        per-epoch default is wired via TaskManager.add_epoch_done_callback)."""
+        if self._evaluation_steps <= 0:
+            return
+        with self._lock:
+            due = model_version >= self._last_eval_version + self._evaluation_steps
+            if not due:
+                return
+            self._last_eval_version = model_version
+        self.trigger_evaluation(model_version)
+
+    def trigger_evaluation(self, model_version: int):
+        """Queue one evaluation round at `model_version`."""
+        count = self._task_manager.create_evaluation_tasks(model_version)
+        with self._lock:
+            if count > 0:
+                self._expected_reports[model_version] = (
+                    self._expected_reports.get(model_version, 0) + count
+                )
+
+    # ------------------------------------------------------------------
+    # Aggregation
+    # ------------------------------------------------------------------
+
+    def report_evaluation_metrics(self, model_version, model_outputs_pb, labels_pb):
+        outputs = {
+            tensor.name or "output": tensor_utils.pb_to_ndarray(tensor)
+            for tensor in model_outputs_pb
+        }
+        labels = tensor_utils.pb_to_ndarray(labels_pb)
+        with self._lock:
+            self._reported.setdefault(model_version, []).append((outputs, labels))
+            self._report_counts[model_version] = (
+                self._report_counts.get(model_version, 0) + 1
+            )
+            expected = self._expected_reports.get(model_version)
+            complete = (
+                expected is not None
+                and self._report_counts[model_version] >= expected
+            )
+        if complete:
+            self._finalize_round(model_version)
+
+    def finalize(self):
+        """Compute metrics for any rounds still holding batches (e.g. a task
+        with zero records never reported, or ad-hoc eval-only jobs)."""
+        with self._lock:
+            pending = [v for v, batches in self._reported.items() if batches]
+        for version in pending:
+            self._finalize_round(version)
+
+    def _finalize_round(self, model_version) -> Dict[str, float]:
+        if self._eval_metrics_fn is None:
+            return {}
+        with self._lock:
+            batches = self._reported.pop(model_version, [])
+            self._report_counts.pop(model_version, None)
+            self._expected_reports.pop(model_version, None)
+        if not batches:
+            return {}
+        output_names = batches[0][0].keys()
+        outputs = {
+            name: np.concatenate([b[0][name] for b in batches]) for name in output_names
+        }
+        labels = np.concatenate([b[1] for b in batches])
+        metric_fns = self._eval_metrics_fn()
+        main_output = (
+            outputs["output"] if "output" in outputs else next(iter(outputs.values()))
+        )
+        metrics = {
+            name: float(np.asarray(fn(main_output, labels)))
+            for name, fn in metric_fns.items()
+        }
+        logger.info(
+            "Eval metrics at version %d (%d examples): %s",
+            model_version,
+            len(labels),
+            {k: round(v, 5) for k, v in metrics.items()},
+        )
+        if self._tensorboard_service is not None:
+            self._tensorboard_service.write_dict_to_summary(metrics, model_version)
+        with self._lock:
+            self._latest_metrics = metrics
+        return metrics
+
+    @property
+    def latest_metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._latest_metrics)

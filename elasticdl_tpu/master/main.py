@@ -1,0 +1,141 @@
+"""Master assembly and entrypoint.
+
+Parity: elasticdl/python/master/main.py in the reference — parse args, build
+the data reader and shards, start the task manager + gRPC services, and (in
+cluster mode) the pod manager.  `build_master` is the reusable in-process
+assembly used by Local mode and by tests.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.common.constants import DistributionStrategy
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_utils import load_model_spec
+from elasticdl_tpu.data.reader import build_data_reader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import MasterServicer, start_master_server
+from elasticdl_tpu.master.task_manager import TaskManager
+
+logger = get_logger("master.main")
+
+
+@dataclass
+class Master:
+    args: object
+    model_spec: object
+    task_manager: TaskManager
+    evaluation_service: Optional[EvaluationService]
+    servicer: MasterServicer
+    server: object = None
+    port: int = 0
+    rendezvous_server: object = None
+    data_reader: object = None
+
+    @property
+    def addr(self) -> str:
+        return f"localhost:{self.port}"
+
+    def stop(self):
+        if self.server is not None:
+            self.server.stop(grace=None)
+
+
+def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
+    model_spec = model_spec or load_model_spec(args)
+
+    training_reader = None
+    training_shards = {}
+    if args.training_data:
+        training_reader = build_data_reader(args, model_spec, args.training_data)
+        training_shards = training_reader.create_shards()
+        if not training_shards:
+            raise ValueError(
+                f"--training_data={args.training_data!r} produced no shards "
+                "(empty/missing path, or the model has no custom_data_reader "
+                "for this scheme)"
+            )
+    evaluation_shards = {}
+    if args.validation_data:
+        eval_reader = build_data_reader(args, model_spec, args.validation_data)
+        evaluation_shards = eval_reader.create_shards()
+    prediction_shards = {}
+    if getattr(args, "prediction_data", ""):
+        pred_reader = build_data_reader(args, model_spec, args.prediction_data)
+        prediction_shards = pred_reader.create_shards()
+
+    task_manager = TaskManager(
+        training_shards=training_shards,
+        evaluation_shards=evaluation_shards,
+        prediction_shards=prediction_shards,
+        records_per_task=args.records_per_task,
+        num_epochs=args.num_epochs,
+        task_timeout_s=args.task_timeout_s,
+    )
+
+    evaluation_service = None
+    if model_spec.eval_metrics_fn is not None and evaluation_shards:
+        evaluation_service = EvaluationService(
+            task_manager,
+            eval_metrics_fn=model_spec.eval_metrics_fn,
+            evaluation_steps=args.evaluation_steps,
+        )
+
+    servicer = MasterServicer(
+        task_manager=task_manager,
+        evaluation_service=evaluation_service,
+        rendezvous_server=rendezvous_server,
+    )
+    if evaluation_service is not None and training_shards:
+        # Always run a final evaluation when training tasks finish.
+        task_manager.add_tasks_done_callback(
+            lambda: evaluation_service.trigger_evaluation(servicer.model_version)
+        )
+        if args.evaluation_steps <= 0:
+            # Default: evaluate at every epoch boundary.
+            task_manager.add_epoch_done_callback(
+                lambda epoch: evaluation_service.trigger_evaluation(
+                    servicer.model_version
+                )
+            )
+    if model_spec.callbacks is not None and training_shards:
+        # Queue the TRAIN_END_CALLBACK task so zoo callbacks() actually run.
+        task_manager.add_tasks_done_callback(task_manager.create_train_end_task)
+    master = Master(
+        args=args,
+        model_spec=model_spec,
+        task_manager=task_manager,
+        evaluation_service=evaluation_service,
+        servicer=servicer,
+        rendezvous_server=rendezvous_server,
+        data_reader=training_reader,
+    )
+    return master
+
+
+def start_master(args, model_spec=None, rendezvous_server=None) -> Master:
+    master = build_master(args, model_spec, rendezvous_server)
+    master.server, master.port = start_master_server(
+        master.servicer, port=args.master_port
+    )
+    return master
+
+
+def main(argv=None):
+    args = parse_master_args(argv)
+    master = start_master(args)
+    logger.info("Master running on port %d", master.port)
+    if args.distribution_strategy == DistributionStrategy.LOCAL:
+        logger.warning(
+            "Master started standalone in Local mode; use `elasticdl train` "
+            "to run master+worker together."
+        )
+    master.server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

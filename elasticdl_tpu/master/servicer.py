@@ -31,6 +31,10 @@ class MasterServicer(_Base):
         self._checkpoint_service = checkpoint_service
         self._model_version = 0
 
+    @property
+    def model_version(self) -> int:
+        return self._model_version
+
     # ------------------------------------------------------------------
     # Task dispatch
     # ------------------------------------------------------------------

@@ -107,6 +107,7 @@ class TaskManager:
         self._permanently_failed: List[_Task] = []
         self._tasks_done_callbacks: List[Callable[[], None]] = []
         self._done_callbacks_fired = False
+        self._epoch_done_callbacks: List[Callable[[int], None]] = []
 
         if self._training_shards:
             self._create_training_tasks_locked()
@@ -182,23 +183,33 @@ class TaskManager:
         still outstanding (`doing` non-empty or epochs remain), and a task
         with task_id == -1 when the job is complete.
         """
-        with self._lock:
-            self._recover_timed_out_locked()
-            if not self._todo and not self._doing:
-                # Current epoch fully finished: advance or end.
-                if self._epoch + 1 < self._num_epochs and self._training_shards:
-                    self._epoch += 1
-                    self._create_training_tasks_locked()
-                else:
-                    return pb.Task(task_id=-1)
-            if not self._todo:
-                return pb.Task(task_id=-1, type=pb.WAIT)
+        finished_epoch = None
+        try:
+            with self._lock:
+                self._recover_timed_out_locked()
+                if not self._todo and not self._doing:
+                    # Current epoch fully finished: advance or end.
+                    if self._epoch + 1 < self._num_epochs and self._training_shards:
+                        finished_epoch = self._epoch
+                        self._epoch += 1
+                        self._create_training_tasks_locked()
+                    else:
+                        return pb.Task(task_id=-1)
+                if not self._todo:
+                    return pb.Task(task_id=-1, type=pb.WAIT)
 
-            task = self._todo.popleft()
-            self._task_id += 1
-            task_id = self._task_id
-            self._doing[task_id] = (worker_id, task, time.time())
-            return task.to_proto(task_id)
+                task = self._todo.popleft()
+                self._task_id += 1
+                task_id = self._task_id
+                self._doing[task_id] = (worker_id, task, time.time())
+                return task.to_proto(task_id)
+        finally:
+            if finished_epoch is not None:
+                for callback in self._epoch_done_callbacks:
+                    try:
+                        callback(finished_epoch)
+                    except Exception:
+                        logger.exception("epoch-done callback failed")
 
     def report(self, task_id: int, success: bool, worker_id: int = -1,
                exec_counters: Optional[Dict[str, int]] = None) -> bool:
@@ -281,6 +292,17 @@ class TaskManager:
     def add_tasks_done_callback(self, callback: Callable[[], None]):
         with self._lock:
             self._tasks_done_callbacks.append(callback)
+
+    def add_epoch_done_callback(self, callback: Callable[[int], None]):
+        """Called (outside the lock) each time a training epoch completes
+        and the next epoch's tasks have been queued."""
+        with self._lock:
+            self._epoch_done_callbacks.append(callback)
+
+    def create_train_end_task(self) -> None:
+        """Queue the TRAIN_END_CALLBACK task (runs model-zoo callbacks)."""
+        with self._lock:
+            self._todo.append(_Task("", 0, 0, pb.TRAIN_END_CALLBACK))
 
     def finished(self) -> bool:
         with self._lock:

@@ -1,0 +1,36 @@
+"""Worker pod/process entrypoint.
+
+Parity: elasticdl/python/worker/main.py in the reference.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from elasticdl_tpu.common.args import parse_worker_args
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_utils import load_model_spec
+from elasticdl_tpu.data.reader import build_data_reader
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+logger = get_logger("worker.main")
+
+
+def main(argv=None):
+    args = parse_worker_args(argv)
+    model_spec = load_model_spec(args)
+    data_reader = build_data_reader(args, model_spec, args.training_data)
+    client = MasterClient(args.master_addr, worker_id=args.worker_id)
+    worker = Worker(
+        master_client=client,
+        model_spec=model_spec,
+        data_reader=data_reader,
+        minibatch_size=args.minibatch_size,
+    )
+    worker.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""The jit-compiled training/evaluation step.
+
+Parity: the reference's per-minibatch work in
+elasticdl/python/worker/worker.py (`training_process_eagerly`,
+`forward_process`) — TF eager GradientTape there; here a single XLA-compiled
+function: forward + backward + optimizer apply fused into one program, so
+elementwise ops fuse into the matmuls and the whole step is one device
+launch per minibatch.  Optimizers are optax transforms (the reference's Go
+PS applied Eigen kernels server-side; on TPU the update is part of the step).
+"""
+
+from __future__ import annotations
+
+import inspect
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("worker.trainer")
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    model_state: Any  # non-trainable collections, e.g. batch_stats
+
+
+def _model_apply(model, variables, features, train: bool, mutable):
+    """Call a flax module, passing `train` only if the model accepts it."""
+    call_params = inspect.signature(model.__call__).parameters
+    kwargs = {}
+    if "train" in call_params:
+        kwargs["train"] = train
+    if mutable:
+        return model.apply(variables, features, mutable=mutable, **kwargs)
+    return model.apply(variables, features, **kwargs), {}
+
+
+class Trainer:
+    """Owns model variables and the jitted train/eval steps for one device.
+
+    The distributed trainers (allreduce / sharded-embedding) wrap the same
+    loss/grad core with shard_map over a Mesh; this class is the Local-mode
+    and single-chip path.
+    """
+
+    def __init__(
+        self,
+        model,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        seed: int = 0,
+    ):
+        self._model = model
+        self._loss_fn = loss_fn
+        self._tx = optimizer
+        self._seed = seed
+        self._state: Optional[TrainState] = None
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
+        self._eval_step = jax.jit(self._eval_step_impl)
+
+    # ------------------------------------------------------------------
+
+    def _init_state(self, features) -> TrainState:
+        rng = jax.random.PRNGKey(self._seed)
+        variables = self._model.init(rng, jnp.asarray(features))
+        variables = dict(variables)
+        params = variables.pop("params")
+        model_state = variables  # batch_stats etc (may be empty)
+        opt_state = self._tx.init(params)
+        logger.info(
+            "Initialized model: %d parameters",
+            sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params)),
+        )
+        return TrainState(jnp.zeros((), jnp.int32), params, opt_state, model_state)
+
+    def ensure_initialized(self, features):
+        if self._state is None:
+            self._state = self._init_state(features)
+        return self._state
+
+    @property
+    def state(self) -> Optional[TrainState]:
+        return self._state
+
+    @state.setter
+    def state(self, value: TrainState):
+        self._state = value
+
+    @property
+    def step(self) -> int:
+        return 0 if self._state is None else int(self._state.step)
+
+    # ------------------------------------------------------------------
+
+    def _train_step_impl(self, state: TrainState, features, labels):
+        mutable_keys = list(state.model_state.keys())
+
+        def compute_loss(params):
+            variables = {"params": params, **state.model_state}
+            (outputs, new_model_state) = _model_apply(
+                self._model, variables, features, train=True, mutable=mutable_keys
+            )
+            loss = self._loss_fn(labels, outputs)
+            return loss, new_model_state
+
+        (loss, new_model_state), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        updates, new_opt_state = self._tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        if not mutable_keys:
+            new_model_state = state.model_state
+        return (
+            TrainState(state.step + 1, new_params, new_opt_state, new_model_state),
+            loss,
+        )
+
+    def _eval_step_impl(self, state: TrainState, features):
+        variables = {"params": state.params, **state.model_state}
+        outputs, _ = _model_apply(
+            self._model, variables, features, train=False, mutable=False
+        )
+        return outputs
+
+    # ------------------------------------------------------------------
+
+    def train_step(self, features, labels) -> float:
+        state = self.ensure_initialized(features)
+        self._state, loss = self._train_step(state, features, labels)
+        return loss
+
+    def eval_step(self, features):
+        state = self.ensure_initialized(features)
+        return self._eval_step(state, features)
+
+    def get_variables_numpy(self) -> dict:
+        """Flat {path: np.ndarray} view of all variables (for export/ckpt)."""
+        state = self._state
+        if state is None:
+            return {}
+        flat = {}
+        tree = {"params": state.params, **state.model_state}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            key = "/".join(str(getattr(p, "key", p)) for p in path)
+            flat[key] = np.asarray(leaf)
+        return flat

@@ -1,0 +1,179 @@
+"""The worker runtime: task loop around the jitted step.
+
+Parity: elasticdl/python/worker/worker.py in the reference — `Worker.run()`
+pulls tasks from the master, builds the per-task dataset, runs the
+minibatch loop, and reports results; evaluation tasks run forward-only and
+ship outputs/labels to the master for aggregation.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import Mode, TaskExecCounterKey
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_utils import ModelSpec
+from elasticdl_tpu.data.task_data_service import TaskDataService
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.trainer import Trainer
+
+logger = get_logger("worker.worker")
+
+
+class Worker:
+    def __init__(
+        self,
+        master_client,
+        model_spec: ModelSpec,
+        data_reader,
+        minibatch_size: int,
+        trainer: Optional[Trainer] = None,
+        report_version_every_steps: int = 20,
+        wait_sleep_s: float = 0.5,
+        max_consecutive_task_failures: int = 10,
+    ):
+        self._mc = master_client
+        self._spec = model_spec
+        self._minibatch_size = minibatch_size
+        self._task_data_service = TaskDataService(
+            data_reader, model_spec.dataset_fn
+        )
+        self._trainer = trainer or Trainer(
+            model=model_spec.build_model(),
+            loss_fn=model_spec.loss,
+            optimizer=model_spec.optimizer(),
+        )
+        self._report_every = report_version_every_steps
+        self._wait_sleep_s = wait_sleep_s
+        self._max_consecutive_failures = max_consecutive_task_failures
+        self._last_reported_version = 0
+
+    @property
+    def trainer(self) -> Trainer:
+        return self._trainer
+
+    # ------------------------------------------------------------------
+
+    def run(self):
+        """Main loop: pull tasks until the master says the job is done."""
+        consecutive_failures = 0
+        while True:
+            task = self._mc.get_task()
+            if task.task_id == -1 and task.type != pb.WAIT:
+                logger.info("Job complete; worker %d exiting", self._mc.worker_id)
+                break
+            if task.type == pb.WAIT:
+                time.sleep(self._wait_sleep_s)
+                continue
+            try:
+                counters = self._process_task(task)
+                self._mc.report_task_result(task.task_id, "", counters)
+                consecutive_failures = 0
+            except Exception as exc:
+                logger.error("Task %d failed:\n%s", task.task_id, traceback.format_exc())
+                self._mc.report_task_result(task.task_id, str(exc) or repr(exc))
+                consecutive_failures += 1
+                if consecutive_failures >= self._max_consecutive_failures:
+                    raise RuntimeError(
+                        f"{consecutive_failures} consecutive task failures; "
+                        "worker aborting"
+                    ) from exc
+        # Final version report so master-side services see the last step.
+        self._report_version(force=True)
+
+    # ------------------------------------------------------------------
+
+    def _process_task(self, task) -> dict:
+        if task.type == pb.TRAINING:
+            return self._process_train_task(task)
+        if task.type == pb.EVALUATION:
+            return self._process_eval_task(task)
+        if task.type == pb.PREDICTION:
+            return self._process_predict_task(task)
+        if task.type == pb.TRAIN_END_CALLBACK:
+            return self._process_train_end(task)
+        raise ValueError(f"Unknown task type {task.type}")
+
+    def _get_batches(self, task, mode: str):
+        # The user's dataset_fn parses/shuffles records; the worker applies
+        # the job-level minibatch batching (reference worker behavior).
+        dataset = self._task_data_service.get_dataset(task, mode)
+        return dataset.batch(self._minibatch_size)
+
+    def _process_train_task(self, task) -> dict:
+        dataset = self._get_batches(task, Mode.TRAINING)
+        batch_count = 0
+        record_count = 0
+        last_loss = None
+        for features, labels in dataset:
+            last_loss = self._trainer.train_step(features, labels)
+            batch_count += 1
+            record_count += _batch_size_of(features)
+            if self._trainer.step % self._report_every == 0:
+                self._report_version()
+        if last_loss is not None:
+            logger.info(
+                "task %d done: step=%d loss=%.5f (%d batches)",
+                task.task_id,
+                self._trainer.step,
+                float(last_loss),
+                batch_count,
+            )
+        self._report_version()
+        return {
+            TaskExecCounterKey.BATCH_COUNT: batch_count,
+            TaskExecCounterKey.RECORD_COUNT: record_count,
+        }
+
+    def _process_eval_task(self, task) -> dict:
+        dataset = self._get_batches(task, Mode.EVALUATION)
+        outputs_list = []
+        labels_list = []
+        batch_count = 0
+        for features, labels in dataset:
+            outputs = self._trainer.eval_step(features)
+            outputs_list.append(np.asarray(outputs))
+            labels_list.append(np.asarray(labels))
+            batch_count += 1
+        if outputs_list:
+            # Report under the round's version so the master aggregates all
+            # of a round's tasks together regardless of worker step skew.
+            self._mc.report_evaluation_metrics(
+                model_version=task.model_version,
+                model_outputs={"output": np.concatenate(outputs_list)},
+                labels=np.concatenate(labels_list),
+            )
+        return {TaskExecCounterKey.BATCH_COUNT: batch_count}
+
+    def _process_predict_task(self, task) -> dict:
+        dataset = self._get_batches(task, Mode.PREDICTION)
+        batch_count = 0
+        for batch in dataset:
+            features = batch[0] if isinstance(batch, tuple) else batch
+            self._trainer.eval_step(features)
+            batch_count += 1
+        return {TaskExecCounterKey.BATCH_COUNT: batch_count}
+
+    def _process_train_end(self, task) -> dict:
+        if self._spec.callbacks is not None:
+            for callback in self._spec.callbacks() or []:
+                callback(self)
+        return {}
+
+    def _report_version(self, force: bool = False):
+        step = self._trainer.step
+        if force or step > self._last_reported_version:
+            self._mc.report_version(step)
+            self._last_reported_version = step
+
+
+def _batch_size_of(features) -> int:
+    if isinstance(features, dict):
+        features = next(iter(features.values()))
+    if isinstance(features, (tuple, list)):
+        features = features[0]
+    return int(np.asarray(features).shape[0])

@@ -1,0 +1,57 @@
+"""Shared dataset helpers for model-zoo modules.
+
+The environment has no network egress, so each zoo config can fall back to a
+deterministic synthetic dataset with the same shapes/dtypes as the real one
+(`synthetic://<name>?n=<records>` data paths).  Real data works through the
+standard readers (csv/recordio) when a path is given.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+
+import numpy as np
+
+from elasticdl_tpu.data.reader import NumpyDataReader
+
+
+def parse_synthetic_path(data_path: str):
+    """'synthetic://mnist?n=4096&seed=3' -> ('mnist', {'n': 4096, 'seed': 3})."""
+    parsed = urllib.parse.urlparse(data_path)
+    if parsed.scheme != "synthetic":
+        return None, {}
+    params = {
+        key: int(values[0])
+        for key, values in urllib.parse.parse_qs(parsed.query).items()
+    }
+    return parsed.netloc, params
+
+
+def synthetic_mnist_reader(n: int = 4096, seed: int = 0, shard_name="mnist-synth"):
+    """MNIST-shaped learnable synthetic data: 28x28 uint8 images whose label
+    is recoverable from the image content (class-dependent mean patches), so
+    training loss genuinely decreases."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    # Class template: a distinct bright 7x7 patch position per class.
+    images = rng.integers(0, 64, size=(n, 28, 28)).astype(np.uint8)
+    for cls in range(10):
+        rows = (cls // 5) * 14 + 3
+        cols = (cls % 5) * 5 + 1
+        mask = labels == cls
+        images[mask, rows : rows + 7, cols : cols + 5] = 200
+    return NumpyDataReader(images, labels, shard_name=shard_name)
+
+
+def synthetic_classification_reader(
+    n: int, num_features: int, num_classes: int, seed: int = 0, shard_name="synth"
+):
+    """Generic learnable tabular classification data (float32 features)."""
+    rng = np.random.default_rng(seed)
+    weights = rng.standard_normal((num_features, num_classes)).astype(np.float32)
+    features = rng.standard_normal((n, num_features)).astype(np.float32)
+    logits = features @ weights + 0.1 * rng.standard_normal((n, num_classes)).astype(
+        np.float32
+    )
+    labels = np.argmax(logits, axis=1).astype(np.int32)
+    return NumpyDataReader(features, labels, shard_name=shard_name)

@@ -1,0 +1,74 @@
+"""MNIST DNN — model-zoo contract, JAX/flax body.
+
+Parity: model_zoo/mnist/mnist_functional_api.py in the reference (a Keras
+functional-API DNN with the contract functions custom_model / loss /
+optimizer / dataset_fn / eval_metrics_fn).  Same function names, TPU-first
+bodies: a flax module compiled by XLA, optax optimizer, numpy host pipeline.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from model_zoo import datasets
+
+
+class MnistDNN(nn.Module):
+    hidden_dim: int = 128
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden_dim)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden_dim // 2)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def custom_model(hidden_dim: int = 128):
+    return MnistDNN(hidden_dim=hidden_dim)
+
+
+def loss(labels, predictions):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels.astype(jnp.int32)
+    ).mean()
+
+
+def optimizer(lr: float = 0.1):
+    return optax.sgd(lr, momentum=0.9)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def parse(record):
+        image, label = record
+        return np.asarray(image, np.float32) / 255.0, np.int32(label)
+
+    dataset = dataset.map(parse)
+    if mode == "training":
+        dataset = dataset.shuffle(1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda outputs, labels: np.mean(
+            np.argmax(outputs, axis=1) == labels.astype(np.int64)
+        ),
+        "loss": lambda outputs, labels: float(
+            loss(jnp.asarray(labels), jnp.asarray(outputs))
+        ),
+    }
+
+
+def custom_data_reader(data_path: str, **kwargs):
+    name, params = datasets.parse_synthetic_path(data_path)
+    if name is None:
+        return None  # fall through to the standard readers
+    return datasets.synthetic_mnist_reader(
+        n=params.get("n", 4096), seed=params.get("seed", 0)
+    )

@@ -8,7 +8,7 @@ process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +16,10 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 # Keep XLA compilation single-threaded-friendly on the 1-core CI host.
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+# The environment's TPU plugin (sitecustomize) force-updates jax_platforms
+# at interpreter start, overriding the env var — pin it back to CPU before
+# any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

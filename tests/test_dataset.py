@@ -1,0 +1,82 @@
+"""Dataset pipeline and record-file codec tests."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data import recordfile
+from elasticdl_tpu.data.dataset import Dataset
+
+
+class TestDataset:
+    def test_map_batch(self):
+        ds = Dataset.from_iterable(range(10)).map(lambda x: x * 2).batch(4)
+        batches = list(ds)
+        assert len(batches) == 3
+        np.testing.assert_array_equal(batches[0], [0, 2, 4, 6])
+        np.testing.assert_array_equal(batches[2], [16, 18])
+
+    def test_batch_drop_remainder(self):
+        ds = Dataset.from_iterable(range(10)).batch(4, drop_remainder=True)
+        assert len(list(ds)) == 2
+
+    def test_tuple_records_stack(self):
+        records = [(np.ones(3) * i, i) for i in range(4)]
+        ds = Dataset.from_iterable(records).batch(2)
+        features, labels = next(iter(ds))
+        assert features.shape == (2, 3)
+        assert labels.shape == (2,)
+
+    def test_dict_records_stack(self):
+        records = [{"a": np.float32(i), "b": np.arange(2)} for i in range(4)]
+        batch = next(iter(Dataset.from_iterable(records).batch(4)))
+        assert batch["a"].shape == (4,)
+        assert batch["b"].shape == (4, 2)
+
+    def test_shuffle_is_permutation(self):
+        ds = Dataset.from_iterable(range(100)).shuffle(16, seed=1)
+        out = list(ds)
+        assert sorted(out) == list(range(100))
+        assert out != list(range(100))
+
+    def test_reiterable(self):
+        ds = Dataset.from_iterable(range(5)).map(lambda x: x + 1)
+        assert list(ds) == list(ds) == [1, 2, 3, 4, 5]
+
+    def test_filter_and_repeat(self):
+        ds = Dataset.from_iterable(range(6)).filter(lambda x: x % 2 == 0).repeat(2)
+        assert list(ds) == [0, 2, 4, 0, 2, 4]
+
+
+class TestRecordFile:
+    def test_write_read_roundtrip(self, tmp_path):
+        path = str(tmp_path / "data.rio")
+        records = [f"record-{i}".encode() for i in range(100)]
+        assert recordfile.write_records(path, records) == 100
+        assert recordfile.count_records(path) == 100
+        assert list(recordfile.read_all(path)) == records
+
+    def test_read_range_seeks(self, tmp_path):
+        path = str(tmp_path / "data.rio")
+        recordfile.write_records(path, [bytes([i]) * (i + 1) for i in range(50)])
+        got = list(recordfile.read_range(path, 10, 13))
+        assert got == [bytes([10]) * 11, bytes([11]) * 12, bytes([12]) * 13]
+        assert list(recordfile.read_range(path, 48, 999)) == [
+            bytes([48]) * 49,
+            bytes([49]) * 50,
+        ]
+        assert list(recordfile.read_range(path, 30, 30)) == []
+
+    def test_corruption_detected(self, tmp_path):
+        path = str(tmp_path / "data.rio")
+        recordfile.write_records(path, [b"hello world" * 10])
+        raw = bytearray(open(path, "rb").read())
+        raw[20] ^= 0xFF  # flip a payload byte
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(recordfile.RecordFileError):
+            list(recordfile.read_all(path))
+
+    def test_not_a_recordfile(self, tmp_path):
+        path = str(tmp_path / "bogus.rio")
+        open(path, "wb").write(b"not a record file at all, definitely")
+        with pytest.raises(recordfile.RecordFileError):
+            recordfile.count_records(path)

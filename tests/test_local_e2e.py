@@ -1,0 +1,132 @@
+"""Local-mode end-to-end: `elasticdl train` on MNIST DNN (BASELINE config 1).
+
+Parity: the reference's local-mode CI smoke test (SURVEY.md §4) — master +
+worker in one process, real gRPC, loss must decrease and eval must report.
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.client import api
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.common.model_utils import load_model_spec
+
+
+def _train_args(tmp_path, extra=()):
+    return parse_master_args(
+        [
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api",
+            "--distribution_strategy", "Local",
+            "--training_data", "synthetic://mnist?n=640",
+            "--validation_data", "synthetic://mnist?n=256&seed=9",
+            "--records_per_task", "320",
+            "--minibatch_size", "32",
+            "--num_epochs", "1",
+            "--output", str(tmp_path / "model"),
+            *extra,
+        ]
+    )
+
+
+def test_local_train_end_to_end(tmp_path):
+    args = _train_args(tmp_path)
+    losses = []
+
+    # Wrap the trainer step to observe the loss trajectory.
+    from elasticdl_tpu.worker import trainer as trainer_mod
+
+    original = trainer_mod.Trainer.train_step
+
+    def spy(self, features, labels):
+        loss = original(self, features, labels)
+        losses.append(float(loss))
+        return loss
+
+    trainer_mod.Trainer.train_step = spy
+    try:
+        assert api._run_local(args, mode="training") == 0
+    finally:
+        trainer_mod.Trainer.train_step = original
+
+    assert len(losses) == 20  # 640 records / 32 batch
+    # Loss decreases substantially on the learnable synthetic task.
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7
+
+    saved = np.load(str(tmp_path / "model.npz"))
+    assert any(key.startswith("params/") for key in saved.files)
+
+
+def test_local_evaluate_only(tmp_path):
+    args = parse_master_args(
+        [
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api",
+            "--distribution_strategy", "Local",
+            "--validation_data", "synthetic://mnist?n=128",
+            "--records_per_task", "64",
+            "--minibatch_size", "32",
+        ]
+    )
+    assert api._run_local(args, mode="evaluation") == 0
+
+
+def test_model_spec_loading():
+    args = parse_master_args(
+        ["--model_zoo", "model_zoo", "--model_def", "mnist.mnist_functional_api"]
+    )
+    spec = load_model_spec(args)
+    model = spec.build_model()
+    assert model.hidden_dim == 128
+    assert spec.eval_metrics_fn is not None
+    assert spec.custom_data_reader is not None
+
+
+def test_model_params_passthrough():
+    args = parse_master_args(
+        [
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api",
+            "--model_params", "hidden_dim=32",
+        ]
+    )
+    spec = load_model_spec(args)
+    assert spec.build_model().hidden_dim == 32
+
+
+def test_per_epoch_eval_and_train_end_callbacks(tmp_path, monkeypatch):
+    """evaluation_steps=0 evaluates at each epoch boundary; zoo callbacks()
+    run via the TRAIN_END_CALLBACK task."""
+    from model_zoo.mnist import mnist_functional_api as zoo
+
+    ran = []
+    monkeypatch.setattr(
+        zoo, "callbacks", lambda: [lambda worker: ran.append(worker)], raising=False
+    )
+    from elasticdl_tpu.master import evaluation_service as es_mod
+
+    rounds = []
+    original = es_mod.EvaluationService.trigger_evaluation
+
+    def spy(self, model_version):
+        rounds.append(model_version)
+        return original(self, model_version)
+
+    monkeypatch.setattr(es_mod.EvaluationService, "trigger_evaluation", spy)
+
+    args = parse_master_args(
+        [
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api",
+            "--distribution_strategy", "Local",
+            "--training_data", "synthetic://mnist?n=256",
+            "--validation_data", "synthetic://mnist?n=64&seed=9",
+            "--records_per_task", "128",
+            "--minibatch_size", "32",
+            "--num_epochs", "3",
+        ]
+    )
+    assert api._run_local(args, mode="training") == 0
+    # 2 epoch boundaries (after epochs 0 and 1) + 1 final round.
+    assert len(rounds) == 3
+    assert len(ran) == 1  # train-end callback ran exactly once

@@ -1,0 +1,82 @@
+"""Data reader tests (parity: data_reader_test.py in the reference)."""
+
+import numpy as np
+
+from elasticdl_tpu.data import recordfile
+from elasticdl_tpu.data.reader import (
+    CSVDataReader,
+    NumpyDataReader,
+    RecordIODataReader,
+    TextLineDataReader,
+    create_data_reader,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+def make_task(shard_name, start, end):
+    return pb.Task(task_id=1, shard_name=shard_name, start=start, end=end)
+
+
+class TestNumpyReader:
+    def test_shards_and_records(self):
+        features = np.arange(20).reshape(10, 2)
+        labels = np.arange(10)
+        reader = NumpyDataReader(features, labels, shard_name="mem")
+        assert reader.create_shards() == {"mem": 10}
+        records = list(reader.read_records(make_task("mem", 3, 6)))
+        assert len(records) == 3
+        np.testing.assert_array_equal(records[0][0], [6, 7])
+        assert records[0][1] == 3
+
+
+class TestCSVReader:
+    def test_shards_and_range(self, tmp_path):
+        for name, rows in (("a.csv", 5), ("b.csv", 3)):
+            with open(tmp_path / name, "w") as f:
+                f.write("x,y\n")
+                for i in range(rows):
+                    f.write(f"{i},{i * 2}\n")
+        reader = CSVDataReader(data_dir=str(tmp_path))
+        shards = reader.create_shards()
+        assert shards == {str(tmp_path / "a.csv"): 5, str(tmp_path / "b.csv"): 3}
+        assert reader.metadata.column_names == ["x", "y"]
+        rows = list(reader.read_records(make_task(str(tmp_path / "a.csv"), 2, 4)))
+        assert rows == [["2", "4"], ["3", "6"]]
+
+
+class TestTextLineReader:
+    def test_range(self, tmp_path):
+        path = tmp_path / "lines.txt"
+        path.write_text("".join(f"line{i}\n" for i in range(10)))
+        reader = TextLineDataReader(data_dir=str(path))
+        assert reader.create_shards() == {str(path): 10}
+        assert list(reader.read_records(make_task(str(path), 8, 12))) == [
+            "line8",
+            "line9",
+        ]
+
+
+class TestRecordIOReader:
+    def test_shards_and_range(self, tmp_path):
+        path = str(tmp_path / "part-0.rio")
+        recordfile.write_records(path, [f"r{i}".encode() for i in range(25)])
+        reader = RecordIODataReader(data_dir=str(tmp_path))
+        assert reader.create_shards() == {path: 25}
+        got = list(reader.read_records(make_task(path, 20, 25)))
+        assert got[0] == b"r20" and got[-1] == b"r24"
+
+
+class TestFactory:
+    def test_infer_csv(self, tmp_path):
+        (tmp_path / "data.csv").write_text("x\n1\n")
+        reader = create_data_reader(str(tmp_path))
+        assert isinstance(reader, CSVDataReader)
+
+    def test_infer_recordio(self, tmp_path):
+        recordfile.write_records(str(tmp_path / "d.rio"), [b"x"])
+        reader = create_data_reader(str(tmp_path))
+        assert isinstance(reader, RecordIODataReader)
+
+    def test_explicit_prefix(self, tmp_path):
+        reader = create_data_reader(f"textline:{tmp_path}")
+        assert isinstance(reader, TextLineDataReader)
