@@ -85,8 +85,11 @@ class CSVDataReader(AbstractDataReader):
         return sorted(glob.glob(self._data_dir))
 
     def _count_records(self, path):
+        # Count parsed rows (not raw lines): quoted fields may contain
+        # newlines, and shard ranges must index the same record stream that
+        # read_records yields.
         with open(path, newline="") as f:
-            count = sum(1 for _ in f)
+            count = sum(1 for _ in csv.reader(f, delimiter=self._sep))
         return count - 1 if self._with_header else count
 
     def create_shards(self):

@@ -38,6 +38,9 @@ class EvaluationService:
         self._reported: Dict[int, List] = {}   # list of (outputs dict, labels)
         self._expected_reports: Dict[int, int] = {}
         self._report_counts: Dict[int, int] = {}
+        # Rounds already finalized: late/duplicate reports (possible under
+        # at-least-once task retry) are dropped, not resurrected.
+        self._finalized_versions: set = set()
         self._latest_metrics: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
@@ -76,6 +79,13 @@ class EvaluationService:
         }
         labels = tensor_utils.pb_to_ndarray(labels_pb)
         with self._lock:
+            if model_version in self._finalized_versions:
+                logger.info(
+                    "Dropping duplicate/late eval report for finalized "
+                    "round %d (at-least-once task retry)",
+                    model_version,
+                )
+                return
             self._reported.setdefault(model_version, []).append((outputs, labels))
             self._report_counts[model_version] = (
                 self._report_counts.get(model_version, 0) + 1
@@ -103,6 +113,7 @@ class EvaluationService:
             batches = self._reported.pop(model_version, [])
             self._report_counts.pop(model_version, None)
             self._expected_reports.pop(model_version, None)
+            self._finalized_versions.add(model_version)
         if not batches:
             return {}
         output_names = batches[0][0].keys()

@@ -107,6 +107,10 @@ class TaskManager:
         self._permanently_failed: List[_Task] = []
         self._tasks_done_callbacks: List[Callable[[], None]] = []
         self._done_callbacks_fired = False
+        # True while done-callbacks are running (they queue final-eval /
+        # TRAIN_END tasks); get() must answer WAIT, not job-complete, until
+        # they finish, or a second worker could exit before those tasks land.
+        self._finalizing = False
         self._epoch_done_callbacks: List[Callable[[int], None]] = []
 
         if self._training_shards:
@@ -184,6 +188,8 @@ class TaskManager:
         with task_id == -1 when the job is complete.
         """
         finished_epoch = None
+        fired_done = False
+        done_callbacks = []
         try:
             with self._lock:
                 self._recover_timed_out_locked()
@@ -193,6 +199,18 @@ class TaskManager:
                         finished_epoch = self._epoch
                         self._epoch += 1
                         self._create_training_tasks_locked()
+                    elif not self._done_callbacks_fired:
+                        # This worker arrived before report() fired the
+                        # done-callbacks (or there were no tasks at all):
+                        # fire them itself, answer WAIT, re-poll.
+                        self._done_callbacks_fired = True
+                        self._finalizing = True
+                        fired_done = True
+                        done_callbacks = list(self._tasks_done_callbacks)
+                        return pb.Task(task_id=-1, type=pb.WAIT)
+                    elif self._finalizing:
+                        # Done-callbacks are still queueing final tasks.
+                        return pb.Task(task_id=-1, type=pb.WAIT)
                     else:
                         return pb.Task(task_id=-1)
                 if not self._todo:
@@ -210,6 +228,8 @@ class TaskManager:
                         callback(finished_epoch)
                     except Exception:
                         logger.exception("epoch-done callback failed")
+            if fired_done:
+                self._run_done_callbacks(done_callbacks)
 
     def report(self, task_id: int, success: bool, worker_id: int = -1,
                exec_counters: Optional[Dict[str, int]] = None) -> bool:
@@ -217,6 +237,7 @@ class TaskManager:
 
         Returns True if the task_id was a known in-flight task.
         """
+        fired_done = False
         callbacks_to_run = []
         with self._lock:
             entry = self._doing.pop(task_id, None)
@@ -246,15 +267,26 @@ class TaskManager:
             if not self._todo and not self._doing and not self._done_callbacks_fired:
                 if self._epoch + 1 >= self._num_epochs or not self._training_shards:
                     self._done_callbacks_fired = True
+                    self._finalizing = True
+                    fired_done = True
                     callbacks_to_run = list(self._tasks_done_callbacks)
-        # Run outside the lock: callbacks may legitimately call back into
-        # the TaskManager API (e.g. to_checkpoint at end of job).
-        for callback in callbacks_to_run:
-            try:
-                callback()
-            except Exception:
-                logger.exception("tasks-done callback failed")
+        if fired_done:
+            self._run_done_callbacks(callbacks_to_run)
         return True
+
+    def _run_done_callbacks(self, callbacks):
+        """Run tasks-done callbacks outside the lock (they may call back
+        into the TaskManager, e.g. create_evaluation_tasks), then lift the
+        finalizing gate so get() may answer job-complete."""
+        try:
+            for callback in callbacks:
+                try:
+                    callback()
+                except Exception:
+                    logger.exception("tasks-done callback failed")
+        finally:
+            with self._lock:
+                self._finalizing = False
 
     def recover_tasks(self, worker_id: int) -> int:
         """Requeue all tasks in-flight on a dead/removed worker."""

@@ -1,0 +1,21 @@
+"""TPU-native parallelism: device meshes, sharded trainers, collectives.
+
+This package replaces the reference's three communication planes
+(SURVEY.md §5) the TPU way:
+
+- NCCL/Gloo rings (FTlib / elastic Horovod)  →  XLA collectives compiled
+  into the step function over a `jax.sharding.Mesh` (ICI within a slice,
+  DCN across slices).
+- The Go parameter server's data plane      →  sharded HBM arrays
+  (see elasticdl_tpu.layers.embedding for the table-sharded path).
+- Elastic communicator re-formation          →  mesh re-formation over the
+  surviving hosts via `jax.distributed` re-initialization
+  (elasticdl_tpu.parallel.elastic).
+"""
+
+from elasticdl_tpu.parallel.mesh import MeshConfig, build_mesh  # noqa: F401
+from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer  # noqa: F401
+from elasticdl_tpu.parallel.collective import (  # noqa: F401
+    CollectiveCommunicator,
+    CollectiveResult,
+)

@@ -1,0 +1,119 @@
+"""Fault-tolerant collective ops with status results.
+
+Parity: the reference's CollectiveCommunicator (FTlib wrapper,
+collective_ops/communicator.py — SURVEY.md §2.1): `allreduce/broadcast/
+barrier` return SUCCEEDED/FAILED instead of raising, so the training loop
+can react (retry, trigger communicator re-formation) rather than crash.
+
+TPU-native: the data-plane collective is a jitted XLA op over the current
+mesh; what can *fail* is the distributed runtime when a peer process dies
+mid-collective.  We catch that and surface FAILED — the elastic layer
+(parallel/elastic.py) then re-forms the mesh over survivors, exactly where
+the reference re-forms its NCCL ring.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.parallel import sharding as shd
+
+logger = get_logger("parallel.collective")
+
+
+class CollectiveResult(enum.Enum):
+    SUCCEEDED = 0
+    FAILED = 1
+
+
+class CollectiveCommunicator:
+    """Mesh-wide allreduce/broadcast/barrier that reports failure as status.
+
+    `mesh` may span multiple processes (jax.distributed world); single
+    process with N local devices behaves identically (the test harness).
+    """
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._jit_cache: dict = {}
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def _jitted(self, name, fn, in_shardings, out_shardings):
+        import jax
+
+        key = name
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                fn, in_shardings=in_shardings, out_shardings=out_shardings
+            )
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------
+
+    def allreduce(self, data: Any, op: str = "MEAN"):
+        """Mean/sum of a host array over the mesh's device set.
+
+        Returns (CollectiveResult, result_or_None).  Data is replicated in;
+        with every participant contributing via their sharded batch the
+        reduction happens inside the train step — this entry point is the
+        *control-plane* collective (metric sync, param averaging on
+        re-formation), mirroring the reference's usage.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            repl = shd.replicated(self._mesh)
+            batch = shd.batch_sharded(self._mesh)
+            n = shd.data_axis_size(self._mesh)
+
+            def reduce_fn(x):  # x: (n, ...) sharded over data
+                s = jnp.sum(x, axis=0)
+                return s / n if op == "MEAN" else s
+
+            fn = self._jitted(f"allreduce_{op}", reduce_fn, (batch,), repl)
+            tiled = np.broadcast_to(
+                np.asarray(data)[None], (n,) + np.asarray(data).shape
+            )
+            tiled = jax.device_put(jnp.asarray(tiled), batch)
+            return CollectiveResult.SUCCEEDED, np.asarray(fn(tiled))
+        except Exception as exc:  # runtime/peer failure → status, not crash
+            logger.error("allreduce failed: %s", exc)
+            return CollectiveResult.FAILED, None
+
+    def broadcast(self, data: Optional[Any], root: int = 0):
+        """Replicate `data` from the root process to all processes."""
+        import jax
+
+        try:
+            from jax.experimental import multihost_utils
+
+            if jax.process_count() == 1:
+                return CollectiveResult.SUCCEEDED, data
+            result = multihost_utils.broadcast_one_to_all(
+                data, is_source=jax.process_index() == root
+            )
+            return CollectiveResult.SUCCEEDED, jax.tree.map(np.asarray, result)
+        except Exception as exc:
+            logger.error("broadcast failed: %s", exc)
+            return CollectiveResult.FAILED, None
+
+    def barrier(self, name: str = "barrier"):
+        import jax
+
+        try:
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(name)
+            return CollectiveResult.SUCCEEDED
+        except Exception as exc:
+            logger.error("barrier failed: %s", exc)
+            return CollectiveResult.FAILED

@@ -1,0 +1,196 @@
+"""Data-parallel trainer: the AllReduce mode, compiled.
+
+Parity: the reference's AllReduce path (worker/allreduce_trainer.py +
+collective_ops/communicator.py — per-step gradient allreduce over
+NCCL/Gloo, SURVEY.md §3.4).  TPU-native design: parameters are replicated
+over the mesh's `data` axis, the batch is sharded over it, and the gradient
+all-reduce is *not a library call* — XLA inserts `psum` when it lowers the
+replicated-out gradient of a data-sharded loss, and schedules it onto ICI
+overlapped with the backward pass.  One compiled program per step; no
+Horovod, no ring management.
+
+Ragged final batches are padded and masked (see parallel/sharding.py) so
+shapes stay static across the whole epoch — one compilation, every batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.parallel import sharding as shd
+from elasticdl_tpu.worker.trainer import TrainState, _model_apply
+
+logger = get_logger("parallel.dp_trainer")
+
+
+def per_example_loss_fn(loss_fn: Callable) -> Callable:
+    """Lift a batch-mean loss into a per-example loss via vmap.
+
+    The model-zoo contract's `loss(labels, outputs)` returns the batch mean
+    (reference contract).  Applying it to singleton batches under vmap
+    recovers the per-example loss for any mean-of-per-example loss, which
+    lets the trainer mask padded rows exactly.
+    """
+
+    def singleton(label, output):
+        return loss_fn(
+            jax.tree.map(lambda x: x[None], label),
+            jax.tree.map(lambda x: x[None], output),
+        )
+
+    return jax.vmap(singleton)
+
+
+class DataParallelTrainer:
+    """Same public surface as worker.trainer.Trainer, over an N-device mesh.
+
+    Params/opt-state replicated; batch sharded over `data`; loss is a
+    mask-weighted mean so padded rows contribute zero gradient.
+    """
+
+    def __init__(
+        self,
+        model,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        mesh,
+        seed: int = 0,
+    ):
+        self._model = model
+        self._loss_fn = loss_fn
+        self._per_example_loss = per_example_loss_fn(loss_fn)
+        self._tx = optimizer
+        self._mesh = mesh
+        self._seed = seed
+        self._state: Optional[TrainState] = None
+        # Host-side mirror of state.step (avoids a per-batch device sync).
+        self._host_step = 0
+        self._dp = shd.data_axis_size(mesh)
+
+        repl = shd.replicated(mesh)
+        batch = shd.batch_sharded(mesh)
+        self._train_step = jax.jit(
+            self._train_step_impl,
+            in_shardings=(repl, batch, batch, batch),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,),
+        )
+        self._eval_step = jax.jit(
+            self._eval_step_impl,
+            in_shardings=(repl, batch),
+            out_shardings=batch,
+        )
+
+    # -- state ----------------------------------------------------------
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def state(self) -> Optional[TrainState]:
+        return self._state
+
+    @state.setter
+    def state(self, value: TrainState):
+        self._state = jax.device_put(value, shd.replicated(self._mesh))
+        self._host_step = int(value.step)
+
+    @property
+    def step(self) -> int:
+        return self._host_step
+
+    def ensure_initialized(self, features) -> TrainState:
+        if self._state is None:
+            rng = jax.random.PRNGKey(self._seed)
+            variables = dict(self._model.init(rng, jnp.asarray(features)))
+            params = variables.pop("params")
+            state = TrainState(
+                jnp.zeros((), jnp.int32),
+                params,
+                self._tx.init(params),
+                variables,
+            )
+            self._state = jax.device_put(state, shd.replicated(self._mesh))
+            logger.info(
+                "Initialized replicated model over %d-way data parallel: "
+                "%d parameters",
+                self._dp,
+                sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params)),
+            )
+        return self._state
+
+    # -- compiled steps -------------------------------------------------
+
+    def _train_step_impl(self, state: TrainState, features, labels, mask):
+        mutable_keys = list(state.model_state.keys())
+
+        def compute_loss(params):
+            variables = {"params": params, **state.model_state}
+            outputs, new_model_state = _model_apply(
+                self._model, variables, features, train=True, mutable=mutable_keys
+            )
+            losses = self._per_example_loss(labels, outputs)
+            loss = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            return loss, new_model_state
+
+        (loss, new_model_state), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        updates, new_opt_state = self._tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        if not mutable_keys:
+            new_model_state = state.model_state
+        return (
+            TrainState(state.step + 1, new_params, new_opt_state, new_model_state),
+            loss,
+        )
+
+    def _eval_step_impl(self, state: TrainState, features):
+        variables = {"params": state.params, **state.model_state}
+        outputs, _ = _model_apply(
+            self._model, variables, features, train=False, mutable=False
+        )
+        return outputs
+
+    # -- host-side entry points ----------------------------------------
+
+    def _place_batch(self, features, labels=None):
+        features, mask = shd.pad_batch(features, self._dp)
+        if labels is not None:
+            labels, _ = shd.pad_batch(labels, self._dp)
+            labels = shd.shard_batch(labels, self._mesh)
+        features = shd.shard_batch(features, self._mesh)
+        mask = shd.shard_batch(mask, self._mesh)
+        return features, labels, mask
+
+    def train_step(self, features, labels):
+        state = self.ensure_initialized(features)
+        features, labels, mask = self._place_batch(features, labels)
+        self._state, loss = self._train_step(state, features, labels, mask)
+        self._host_step += 1
+        return loss
+
+    def eval_step(self, features):
+        state = self.ensure_initialized(features)
+        n = jax.tree.leaves(features)[0].shape[0]
+        features, _, _ = self._place_batch(features)
+        outputs = self._eval_step(state, features)
+        # Strip padding rows before returning to the host.
+        return jax.tree.map(lambda x: np.asarray(x)[:n], outputs)
+
+    def get_variables_numpy(self) -> dict:
+        if self._state is None:
+            return {}
+        flat = {}
+        tree = {"params": self._state.params, **self._state.model_state}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            key = "/".join(str(getattr(p, "key", p)) for p in path)
+            flat[key] = np.asarray(leaf)
+        return flat

@@ -1,0 +1,68 @@
+"""Sharding helpers: NamedShardings, global-batch assembly, padding.
+
+XLA requires static shapes; the data-parallel batch dim must divide the
+`data` mesh axis.  The reference streams arbitrary-size minibatches through
+TF eager (no such constraint), so the TPU path pads ragged final batches
+and masks padded rows out of the loss — no records are dropped, preserving
+the at-least-once task semantics of the task manager.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.parallel.mesh import DATA_AXIS
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh):
+    """Leading dim sharded over the data axis, rest replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def data_axis_size(mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def pad_batch(tree: Any, multiple: int) -> Tuple[Any, np.ndarray]:
+    """Pad every array's leading dim up to `multiple`; return (tree, mask).
+
+    Padding repeats row 0 (keeps dtypes/values in-distribution so the
+    forward pass stays numerically safe); the mask is 1.0 for real rows and
+    0.0 for padding and is used for the weighted loss.
+    """
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return tree, np.zeros((0,), np.float32)
+    batch = leaves[0].shape[0]
+    padded = -(-batch // multiple) * multiple
+    mask = np.ones((padded,), np.float32)
+    mask[batch:] = 0.0
+    if padded == batch:
+        return tree, mask
+
+    def pad(x):
+        x = np.asarray(x)
+        pad_rows = np.repeat(x[:1], padded - batch, axis=0)
+        return np.concatenate([x, pad_rows], axis=0)
+
+    return jax.tree.map(pad, tree), mask
+
+
+def shard_batch(tree: Any, mesh):
+    """Place a host-global batch onto the mesh, sharded over `data`."""
+    import jax
+
+    sharding = batch_sharded(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -63,6 +63,9 @@ class Trainer:
         self._tx = optimizer
         self._seed = seed
         self._state: Optional[TrainState] = None
+        # Host-side mirror of state.step: reading the device scalar every
+        # batch would force a device sync and serialize the hot loop.
+        self._host_step = 0
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
         self._eval_step = jax.jit(self._eval_step_impl)
 
@@ -93,10 +96,11 @@ class Trainer:
     @state.setter
     def state(self, value: TrainState):
         self._state = value
+        self._host_step = int(value.step)  # one sync on restore, not per batch
 
     @property
     def step(self) -> int:
-        return 0 if self._state is None else int(self._state.step)
+        return self._host_step
 
     # ------------------------------------------------------------------
 
@@ -135,6 +139,7 @@ class Trainer:
     def train_step(self, features, labels) -> float:
         state = self.ensure_initialized(features)
         self._state, loss = self._train_step(state, features, labels)
+        self._host_step += 1
         return loss
 
     def eval_step(self, features):

@@ -1,0 +1,64 @@
+"""Evaluation service aggregation tests.
+
+Parity surface: elasticdl/python/tests/evaluation_service_test.py in the
+reference (round scheduling + metric aggregation from worker reports).
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.task_manager import TaskManager
+
+
+def metrics_fn():
+    return {
+        "accuracy": lambda outputs, labels: np.mean(
+            np.argmax(outputs, axis=-1) == labels
+        )
+    }
+
+
+def report(service, version, outputs, labels):
+    service.report_evaluation_metrics(
+        version,
+        [tensor_utils.ndarray_to_pb(np.asarray(outputs), name="output")],
+        tensor_utils.ndarray_to_pb(np.asarray(labels)),
+    )
+
+
+def make_service(eval_records=20, records_per_task=10):
+    manager = TaskManager(
+        training_shards={"t": 10},
+        evaluation_shards={"v": eval_records},
+        records_per_task=records_per_task,
+    )
+    return EvaluationService(manager, eval_metrics_fn=metrics_fn), manager
+
+
+def test_round_aggregates_all_reports():
+    service, _ = make_service()  # 2 eval tasks expected per round
+    service.trigger_evaluation(model_version=3)
+    out1 = np.array([[0.9, 0.1], [0.2, 0.8]])
+    out2 = np.array([[0.7, 0.3]])
+    report(service, 3, out1, np.array([0, 1]))
+    assert service.latest_metrics == {}  # round not complete yet
+    report(service, 3, out2, np.array([1]))
+    assert service.latest_metrics == {"accuracy": 2.0 / 3.0}
+
+
+def test_duplicate_report_after_finalize_is_dropped():
+    """At-least-once retry can deliver a round's report twice; the stray
+    duplicate must not overwrite the full round's metrics (not at arrival,
+    and not later via finalize())."""
+    service, _ = make_service()
+    service.trigger_evaluation(model_version=5)
+    good = np.array([[0.9, 0.1], [0.2, 0.8]])
+    report(service, 5, good, np.array([0, 1]))
+    report(service, 5, good, np.array([0, 1]))  # completes the round: acc=1.0
+    assert service.latest_metrics == {"accuracy": 1.0}
+    # Late duplicate with all-wrong labels.
+    report(service, 5, good, np.array([1, 0]))
+    assert service.latest_metrics == {"accuracy": 1.0}
+    service.finalize()  # must not resurrect the dropped duplicate
+    assert service.latest_metrics == {"accuracy": 1.0}

@@ -80,3 +80,16 @@ class TestFactory:
     def test_explicit_prefix(self, tmp_path):
         reader = create_data_reader(f"textline:{tmp_path}")
         assert isinstance(reader, TextLineDataReader)
+
+
+class TestCSVQuotedNewlines:
+    def test_shard_count_matches_parsed_rows(self, tmp_path):
+        """Quoted fields containing newlines are one record, not two:
+        create_shards must agree with what read_records yields."""
+        path = tmp_path / "q.csv"
+        path.write_text('x,y\na,"multi\nline"\nb,c\n')
+        reader = CSVDataReader(data_dir=str(tmp_path))
+        shards = reader.create_shards()
+        assert shards == {str(path): 2}
+        rows = list(reader.read_records(make_task(str(path), 0, 2)))
+        assert rows == [["a", "multi\nline"], ["b", "c"]]

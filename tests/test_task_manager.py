@@ -264,3 +264,42 @@ class TestRetryBudget:
             task = manager.get(0)
             manager.report(task.task_id, True, 0, exec_counters={"batch_count": 5})
         assert manager.exec_counters() == {"batch_count": 10}
+
+
+class TestFinalizationRace:
+    def test_second_worker_waits_during_done_callbacks(self):
+        """While done-callbacks queue final-eval/train-end tasks, a second
+        worker polling get() must receive WAIT, not the job-done sentinel."""
+        manager = TaskManager(
+            training_shards={"x": 10},
+            evaluation_shards={"v": 10},
+            records_per_task=10,
+        )
+        seen_during_callback = []
+
+        def queue_final_eval():
+            # Simulate EvaluationService.trigger_evaluation at end of job;
+            # poll from a "second worker" while the callback runs.
+            seen_during_callback.append(manager.get(1))
+            manager.create_evaluation_tasks(model_version=7)
+
+        manager.add_tasks_done_callback(queue_final_eval)
+        task = manager.get(0)
+        manager.report(task.task_id, True, 0)
+        # Poll during callback answered WAIT, not job-complete.
+        assert seen_during_callback[0].type == pb.WAIT
+        # The final eval task queued by the callback is served afterwards.
+        final = manager.get(1)
+        assert final.type == pb.EVALUATION and final.model_version == 7
+        manager.report(final.task_id, True, 1)
+        assert manager.get(1).task_id == -1
+
+    def test_get_fires_done_callbacks_when_no_tasks(self):
+        """A job with zero training tasks still runs its done-callbacks
+        (via get) before workers see job-complete."""
+        manager = TaskManager(training_shards={}, records_per_task=10)
+        fired = []
+        manager.add_tasks_done_callback(lambda: fired.append(True))
+        first = manager.get(0)
+        assert first.type == pb.WAIT and fired == [True]
+        assert manager.get(0).task_id == -1
