@@ -1,0 +1,1 @@
+from elasticdl_tpu.checkpoint.saver import CheckpointSaver  # noqa: F401

@@ -1,0 +1,91 @@
+"""Training-state checkpointing.
+
+Parity: the reference checkpoints PS-side parameters every
+`--checkpoint_steps` versions (pkg/ps/checkpoint.go + the python
+CheckpointSaver, SURVEY.md §5) and resumes from the latest snapshot.
+
+TPU design: checkpoints are the *backbone of elasticity*, not just crash
+insurance — worker churn kills the whole jax.distributed world (a dead host
+takes the slice's coordination service down), so re-formation is
+restart-the-world + restore-latest.  In data-parallel mode the state is
+replicated, so any rank-0 host snapshot is complete; the sharded-embedding
+engine layers orbax sharded save/restore on top of this interface.
+
+Format: one directory per step, written atomically (tmp + rename), holding
+a pickled host pytree.  `keep_max` old checkpoints are retained.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Optional, Tuple
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("checkpoint.saver")
+
+_STATE_FILE = "state.pkl"
+
+
+class CheckpointSaver:
+    def __init__(self, checkpoint_dir: str, keep_max: int = 3):
+        self._dir = checkpoint_dir
+        self._keep_max = keep_max
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, f"step_{step:012d}")
+
+    def steps(self):
+        steps = []
+        for name in os.listdir(self._dir):
+            if name.startswith("step_") and not name.endswith(".tmp"):
+                try:
+                    steps.append(int(name[len("step_"):]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    # ------------------------------------------------------------------
+
+    def save(self, state: Any, step: int) -> str:
+        """Snapshot a (host or device) pytree at `step`, atomically."""
+        import jax
+
+        host_state = jax.device_get(state)
+        final_dir = self._step_dir(step)
+        if os.path.exists(final_dir):
+            return final_dir
+        tmp_dir = tempfile.mkdtemp(
+            prefix=f"step_{step:012d}.tmp", dir=self._dir
+        )
+        with open(os.path.join(tmp_dir, _STATE_FILE), "wb") as f:
+            pickle.dump(host_state, f)
+        os.rename(tmp_dir, final_dir)
+        logger.info("Saved checkpoint at step %d -> %s", step, final_dir)
+        self._garbage_collect()
+        return final_dir
+
+    def load_latest(self) -> Tuple[Optional[Any], int]:
+        """Returns (state, step); (None, 0) when no checkpoint exists.
+        Unreadable/partial snapshots are skipped (next-newest wins)."""
+        for step in reversed(self.steps()):
+            path = os.path.join(self._step_dir(step), _STATE_FILE)
+            try:
+                with open(path, "rb") as f:
+                    state = pickle.load(f)
+                logger.info("Restored checkpoint from step %d", step)
+                return state, step
+            except Exception:
+                logger.exception("Skipping unreadable checkpoint %s", path)
+        return None, 0
+
+    def _garbage_collect(self):
+        steps = self.steps()
+        for step in steps[: -self._keep_max]:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)

@@ -67,6 +67,11 @@ def _run_local(args, mode: str):
         Mode.PREDICTION: args.prediction_data,
     }[mode]
     data_reader = build_data_reader(args, model_spec, data_path)
+    validation_reader = (
+        build_data_reader(args, model_spec, args.validation_data)
+        if args.validation_data and mode == Mode.TRAINING
+        else None
+    )
 
     client = MasterClient(master.addr, worker_id=0)
     worker = Worker(
@@ -74,6 +79,7 @@ def _run_local(args, mode: str):
         model_spec=model_spec,
         data_reader=data_reader,
         minibatch_size=args.minibatch_size,
+        validation_data_reader=validation_reader,
     )
     try:
         worker.run()

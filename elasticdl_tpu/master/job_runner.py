@@ -1,0 +1,88 @@
+"""Cluster-mode job orchestration (master side).
+
+Parity: the master pod's role in elasticdl/python/master/main.py — start
+the control-plane services and the pod manager, then supervise the worker
+fleet until the job completes.  Substrate selection: local subprocesses
+(single-host multi-process — also the test harness) now; the Kubernetes
+pod manager plugs into the same flow.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.master.main import start_master
+from elasticdl_tpu.master.pod_manager import (
+    LocalProcessManager,
+    worker_argv_from_args,
+)
+from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
+
+logger = get_logger("master.job_runner")
+
+
+def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
+    """AllReduce strategy: N worker processes form a jax.distributed world;
+    gradients psum inside the compiled step; churn re-forms the world."""
+    rendezvous = ElasticRendezvous()
+    master = start_master(args, rendezvous_server=rendezvous)
+    if mode == Mode.EVALUATION:
+        if master.evaluation_service is not None:
+            master.evaluation_service.trigger_evaluation(model_version=0)
+        else:
+            master.task_manager.create_evaluation_tasks(model_version=0)
+
+    worker_env = {}
+    if os.environ.get("ELASTICDL_FORCE_PLATFORM"):
+        worker_env["ELASTICDL_FORCE_PLATFORM"] = os.environ[
+            "ELASTICDL_FORCE_PLATFORM"
+        ]
+    # Extra worker env as 'K=V;K2=V2' (e.g. XLA_FLAGS overrides in tests).
+    for pair in os.environ.get("ELASTICDL_WORKER_ENV", "").split(";"):
+        if "=" in pair:
+            key, value = pair.split("=", 1)
+            worker_env[key.strip()] = value
+    manager = LocalProcessManager(
+        num_workers=args.num_workers,
+        worker_argv_fn=worker_argv_from_args(args, master.addr),
+        rendezvous=rendezvous,
+        task_manager=master.task_manager,
+        max_restarts=args.max_worker_restarts,
+        worker_env=worker_env,
+        log_dir=os.path.join(
+            args.checkpoint_dir or tempfile.gettempdir(),
+            f"{args.job_name}_worker_logs",
+        ),
+        job_finished_fn=master.task_manager.finished,
+    )
+    master.pod_manager = manager  # type: ignore[attr-defined]
+    try:
+        manager.start()
+        ok = manager.wait()
+        if master.evaluation_service is not None:
+            master.evaluation_service.finalize()
+            metrics = master.evaluation_service.latest_metrics
+            if metrics:
+                logger.info("Final metrics: %s", metrics)
+        if not ok:
+            logger.error("Job failed: %s", manager.failed_reason)
+            return 1
+        if not master.task_manager.finished():
+            logger.error("Workers exited but tasks remain unfinished")
+            return 1
+        logger.info("AllReduce job complete")
+        return 0
+    finally:
+        manager.stop()
+        master.stop()
+
+
+def run_ps_job(args, mode: str = Mode.TRAINING) -> int:
+    """ParameterServer strategy: on TPU the PS data plane dissolves into
+    mesh-sharded embedding tables + replicated dense params inside the
+    compiled step (SURVEY.md §5); the job topology is the same as
+    AllReduce — workers + master, no separate PS processes to schedule."""
+    return run_allreduce_job(args, mode)

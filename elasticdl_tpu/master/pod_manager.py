@@ -1,0 +1,286 @@
+"""Elastic worker-process management.
+
+Parity: elasticdl/python/master/pod_manager.py (older
+k8s_instance_manager.py) in the reference — create worker pods, watch
+lifecycle events, relaunch failures within a restart budget, and drive task
+recovery + rendezvous reset on churn (SURVEY.md §3.2).
+
+TPU design — restart-the-world: when any member of a jax.distributed world
+dies, the coordination service fatally terminates the surviving processes
+(a dead host takes the slice down; verified empirically on jax 0.9).  So
+churn recovery is not "patch the ring" but: recover all in-flight tasks,
+tear the old world down, declare a new world (same size while the restart
+budget lasts, shrunk otherwise) under a fresh rendezvous id, and relaunch
+workers, which restore model state from the latest checkpoint.  Data
+progress lives in the master's TaskManager, which survives — at-least-once
+semantics mean no records are lost across re-formations.
+
+`LocalProcessManager` is the subprocess-based substrate (local mode, tests,
+single-host multi-process); the Kubernetes pod manager implements the same
+`start/stop/scale` surface over pod events (see master/k8s_client.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("master.pod_manager")
+
+
+class WorkerProcess:
+    def __init__(self, worker_id: int, popen: subprocess.Popen, log_path: str):
+        self.worker_id = worker_id
+        self.popen = popen
+        self.log_path = log_path
+
+
+class LocalProcessManager:
+    """Supervises worker subprocesses with elastic restart-the-world.
+
+    `worker_argv_fn(worker_id)` builds the worker command line;
+    `on_world_change(worker_ids)` is told every new world before launch
+    (wired to ElasticRendezvous.set_worker_hosts and
+    TaskManager.recover_tasks by the caller).
+    """
+
+    def __init__(
+        self,
+        num_workers: int,
+        worker_argv_fn: Callable[[int], List[str]],
+        rendezvous=None,
+        task_manager=None,
+        max_restarts: int = 3,
+        worker_env: Optional[Dict[str, str]] = None,
+        log_dir: str = "",
+        job_finished_fn: Optional[Callable[[], bool]] = None,
+        poll_interval_s: float = 0.2,
+    ):
+        self._num_workers = num_workers
+        self._worker_argv_fn = worker_argv_fn
+        self._rendezvous = rendezvous
+        self._task_manager = task_manager
+        self._max_restarts = max_restarts
+        self._worker_env = dict(worker_env or {})
+        self._log_dir = log_dir
+        self._job_finished_fn = job_finished_fn
+        self._poll_interval_s = poll_interval_s
+
+        self._lock = threading.Lock()
+        self._procs: List[WorkerProcess] = []
+        self._next_worker_id = 0
+        self._restarts_used = 0
+        self._stopped = False
+        self._failed_reason: Optional[str] = None
+        self._done_event = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self):
+        if self._log_dir:
+            os.makedirs(self._log_dir, exist_ok=True)
+        self._launch_world(self._num_workers)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, name="pod-manager-monitor", daemon=True
+        )
+        self._monitor_thread.start()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the job's worker fleet is done. True on success."""
+        if not self._done_event.wait(timeout):
+            raise TimeoutError("Worker fleet did not finish in time")
+        return self._failed_reason is None
+
+    @property
+    def failed_reason(self) -> Optional[str]:
+        return self._failed_reason
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            procs = list(self._procs)
+        self._terminate_procs(procs)
+        self._done_event.set()
+
+    def current_worker_ids(self) -> List[int]:
+        with self._lock:
+            return [wp.worker_id for wp in self._procs]
+
+    def kill_worker(self, worker_id: int, sig: int = 9):
+        """Fault injection / preemption simulation: kill one worker."""
+        with self._lock:
+            for wp in self._procs:
+                if wp.worker_id == worker_id:
+                    try:
+                        wp.popen.send_signal(sig)
+                    except ProcessLookupError:
+                        pass
+                    return
+        raise ValueError(f"No live worker {worker_id}")
+
+    def scale(self, num_workers: int):
+        """Explicit elastic resize: tear down and relaunch at the new size."""
+        with self._lock:
+            if self._stopped:
+                return
+            procs = list(self._procs)
+            self._procs = []
+        logger.info("Scaling world to %d workers", num_workers)
+        self._recover_world_tasks(procs)
+        self._terminate_procs(procs)
+        self._num_workers = num_workers
+        self._launch_world(num_workers)
+
+    # ------------------------------------------------------------------
+    # Internals
+    # ------------------------------------------------------------------
+
+    def _launch_world(self, n: int):
+        with self._lock:
+            worker_ids = list(range(self._next_worker_id, self._next_worker_id + n))
+            self._next_worker_id += n
+        if self._rendezvous is not None:
+            self._rendezvous.set_worker_hosts(
+                [(wid, "127.0.0.1") for wid in worker_ids]
+            )
+        procs = []
+        for wid in worker_ids:
+            argv = self._worker_argv_fn(wid)
+            log_path = (
+                os.path.join(self._log_dir, f"worker_{wid}.log")
+                if self._log_dir
+                else os.devnull
+            )
+            log_file = open(log_path, "wb")
+            env = {**os.environ, **self._worker_env}
+            popen = subprocess.Popen(
+                argv, stdout=log_file, stderr=subprocess.STDOUT, env=env
+            )
+            log_file.close()
+            procs.append(WorkerProcess(wid, popen, log_path))
+            logger.info("Launched worker %d (pid %d)", wid, popen.pid)
+        with self._lock:
+            self._procs = procs
+
+    def _terminate_procs(self, procs: List[WorkerProcess]):
+        for wp in procs:
+            if wp.popen.poll() is None:
+                try:
+                    wp.popen.terminate()
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + 5
+        for wp in procs:
+            try:
+                wp.popen.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                wp.popen.kill()
+                wp.popen.wait()
+
+    def _recover_world_tasks(self, procs: List[WorkerProcess]):
+        if self._task_manager is not None:
+            for wp in procs:
+                self._task_manager.recover_tasks(wp.worker_id)
+
+    def _job_finished(self) -> bool:
+        return bool(self._job_finished_fn and self._job_finished_fn())
+
+    def _monitor_loop(self):
+        while True:
+            time.sleep(self._poll_interval_s)
+            with self._lock:
+                if self._stopped:
+                    return
+                procs = list(self._procs)
+            exited = [(wp, wp.popen.poll()) for wp in procs]
+            exited = [(wp, code) for wp, code in exited if code is not None]
+            if not exited:
+                continue
+            crashed = [(wp, code) for wp, code in exited if code != 0]
+            if crashed and not self._job_finished():
+                self._handle_churn(procs, crashed)
+                with self._lock:
+                    if self._stopped or not self._procs:
+                        return
+                continue
+            if all(wp.popen.poll() is not None for wp in procs):
+                # Whole fleet exited cleanly (or job already done): finished.
+                logger.info("All workers exited; job done")
+                self._done_event.set()
+                return
+
+    def _handle_churn(self, procs: List[WorkerProcess], crashed):
+        """One churn event: any worker death invalidates the whole world."""
+        for wp, code in crashed:
+            logger.warning(
+                "Worker %d died (exit %s) — world re-formation (log: %s)",
+                wp.worker_id,
+                code,
+                wp.log_path,
+            )
+        with self._lock:
+            self._procs = []
+            self._restarts_used += 1
+            budget_left = self._restarts_used <= self._max_restarts
+            old_size = len(procs)
+        self._recover_world_tasks(procs)
+        self._terminate_procs(procs)  # survivors die with the world
+        new_size = old_size if budget_left else old_size - 1
+        if new_size < 1:
+            self._failed_reason = (
+                f"restart budget exhausted ({self._restarts_used - 1} used) "
+                "and no workers left"
+            )
+            logger.error("Job failed: %s", self._failed_reason)
+            self._done_event.set()
+            with self._lock:
+                self._stopped = True
+            return
+        logger.info(
+            "Re-forming world: %d -> %d workers (restart %d/%d)",
+            old_size,
+            new_size,
+            self._restarts_used,
+            self._max_restarts,
+        )
+        self._launch_world(new_size)
+
+
+def worker_argv_from_args(args, master_addr: str) -> Callable[[int], List[str]]:
+    """Build the worker command line from parsed job args (flag round-trip,
+    reference behavior: client flags forward to pods)."""
+    from elasticdl_tpu.common.args import args_to_argv
+
+    forwarded = args_to_argv(
+        args,
+        keys={
+            "model_zoo", "model_def", "model_params", "dataset_fn", "loss",
+            "optimizer", "eval_metrics_fn", "custom_data_reader", "callbacks",
+            "training_data", "validation_data", "prediction_data",
+            "records_per_task", "minibatch_size", "num_epochs",
+            "data_reader_params", "distribution_strategy", "log_level",
+            "checkpoint_dir", "checkpoint_steps", "keep_checkpoint_max",
+            "output", "use_bf16",
+        },
+    )
+
+    def argv_fn(worker_id: int) -> List[str]:
+        return [
+            sys.executable,
+            "-m",
+            "elasticdl_tpu.worker.main",
+            f"--worker_id={worker_id}",
+            f"--master_addr={master_addr}",
+            *forwarded,
+        ]
+
+    return argv_fn

@@ -1,0 +1,118 @@
+"""Elastic rendezvous: assigns ranks for the current alive-worker world.
+
+Parity: elasticdl/python/master/rendezvous_server.py in the reference
+(HorovodRendezvousServer) — the master hosts the rendezvous, assigns ranks
+to the current alive-worker set, and bumps `rendezvous_id` on membership
+change; workers poll `get_comm_rank`.
+
+TPU design: instead of a Horovod-Gloo rendezvous the response carries the
+`jax.distributed` coordinator address (rank 0's host + a master-chosen
+port).  Workers join the world by calling `jax.distributed.initialize`
+with their assigned (rank, world_size, coordinator); the coordination
+service itself then barriers until everyone arrives.  A new world gets a
+fresh coordinator port so stale members of the old world can never join.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger("master.rendezvous")
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]
+
+
+class ElasticRendezvous:
+    """Single source of truth for "the current world"."""
+
+    def __init__(self, coordinator_port_fn=find_free_port):
+        self._lock = threading.Lock()
+        self._coordinator_port_fn = coordinator_port_fn
+        self._rendezvous_id = 0
+        # worker_id (sorted) -> rank; host of rank 0 hosts the coordinator.
+        self._workers: List[Tuple[int, str]] = []  # [(worker_id, host)]
+        self._coordinator_addr = ""
+        self._last_heartbeat: Dict[int, float] = {}
+
+    # ------------------------------------------------------------------
+    # Master/pod-manager side
+    # ------------------------------------------------------------------
+
+    def set_worker_hosts(self, workers: List[Tuple[int, str]]) -> int:
+        """Declare the new world: [(worker_id, host)]. Returns rendezvous_id.
+
+        Ranks are assigned by ascending worker_id; rank 0's host gets the
+        coordinator on a fresh port.
+        """
+        with self._lock:
+            workers = sorted(workers)
+            self._workers = workers
+            self._rendezvous_id += 1
+            if workers:
+                rank0_host = workers[0][1]
+                port = self._coordinator_port_fn(rank0_host)
+                self._coordinator_addr = f"{rank0_host}:{port}"
+            else:
+                self._coordinator_addr = ""
+            self._last_heartbeat = {wid: time.time() for wid, _ in workers}
+            logger.info(
+                "Rendezvous %d: world_size=%d coordinator=%s workers=%s",
+                self._rendezvous_id,
+                len(workers),
+                self._coordinator_addr,
+                [wid for wid, _ in workers],
+            )
+            return self._rendezvous_id
+
+    @property
+    def rendezvous_id(self) -> int:
+        with self._lock:
+            return self._rendezvous_id
+
+    def world(self) -> List[Tuple[int, str]]:
+        with self._lock:
+            return list(self._workers)
+
+    def stale_workers(self, timeout_s: float) -> List[int]:
+        """Workers that have not heartbeated within `timeout_s`."""
+        now = time.time()
+        with self._lock:
+            return [
+                wid
+                for wid, last in self._last_heartbeat.items()
+                if now - last > timeout_s
+            ]
+
+    # ------------------------------------------------------------------
+    # Worker-facing (via servicer)
+    # ------------------------------------------------------------------
+
+    def get_comm_rank(self, worker_id: int) -> pb.GetCommRankResponse:
+        with self._lock:
+            ids = [wid for wid, _ in self._workers]
+            rank = ids.index(worker_id) if worker_id in ids else -1
+            return pb.GetCommRankResponse(
+                rank_id=rank,
+                world_size=len(self._workers),
+                rendezvous_id=self._rendezvous_id,
+                coordinator_addr=self._coordinator_addr,
+                worker_hosts=[host for _, host in self._workers],
+            )
+
+    def report_liveness(self, worker_id: int, host: str, rendezvous_id: int) -> bool:
+        """Heartbeat; returns True when the worker's world is stale (the
+        worker should re-rendezvous)."""
+        with self._lock:
+            if worker_id in self._last_heartbeat:
+                self._last_heartbeat[worker_id] = time.time()
+            return rendezvous_id != self._rendezvous_id

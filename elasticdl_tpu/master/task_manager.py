@@ -338,10 +338,17 @@ class TaskManager:
 
     def finished(self) -> bool:
         with self._lock:
+            no_more_epochs = (
+                self._epoch + 1 >= self._num_epochs or not self._training_shards
+            )
+            # Not finished while done-callbacks are still queueing final
+            # tasks (same gating as get(): see _finalizing).
+            finalization_settled = self._done_callbacks_fired and not self._finalizing
             return (
                 not self._todo
                 and not self._doing
-                and (self._epoch + 1 >= self._num_epochs or not self._training_shards)
+                and no_more_epochs
+                and (finalization_settled or not self._tasks_done_callbacks)
             )
 
     @property

@@ -79,10 +79,14 @@ class CollectiveCommunicator:
                 return s / n if op == "MEAN" else s
 
             fn = self._jitted(f"allreduce_{op}", reduce_fn, (batch,), repl)
-            tiled = np.broadcast_to(
-                np.asarray(data)[None], (n,) + np.asarray(data).shape
+            # Each process contributes copies for its local devices only
+            # (a host-global device_put cannot target non-addressable
+            # devices in a multi-process mesh).
+            local_rows = max(1, n // jax.process_count())
+            local = np.broadcast_to(
+                np.asarray(data)[None], (local_rows,) + np.asarray(data).shape
             )
-            tiled = jax.device_put(jnp.asarray(tiled), batch)
+            tiled = shd.assemble_global_batch(np.ascontiguousarray(local), self._mesh)
             return CollectiveResult.SUCCEEDED, np.asarray(fn(tiled))
         except Exception as exc:  # runtime/peer failure → status, not crash
             logger.error("allreduce failed: %s", exc)

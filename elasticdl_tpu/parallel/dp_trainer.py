@@ -93,14 +93,21 @@ class DataParallelTrainer:
     def mesh(self):
         return self._mesh
 
+    def local_block(self, per_rank_batch: int) -> int:
+        """Rows each process must supply per collective step: the requested
+        per-rank batch rounded up to a multiple of the process's local
+        device count (the global batch must divide the `data` axis)."""
+        local_devices = max(1, self._dp // jax.process_count())
+        return -(-per_rank_batch // local_devices) * local_devices
+
     @property
     def state(self) -> Optional[TrainState]:
         return self._state
 
     @state.setter
     def state(self, value: TrainState):
-        self._state = jax.device_put(value, shd.replicated(self._mesh))
-        self._host_step = int(value.step)
+        self._state = shd.put_replicated(value, self._mesh)
+        self._host_step = int(np.asarray(jax.device_get(value.step)))
 
     @property
     def step(self) -> int:
@@ -117,7 +124,7 @@ class DataParallelTrainer:
                 self._tx.init(params),
                 variables,
             )
-            self._state = jax.device_put(state, shd.replicated(self._mesh))
+            self._state = shd.put_replicated(jax.device_get(state), self._mesh)
             logger.info(
                 "Initialized replicated model over %d-way data parallel: "
                 "%d parameters",
@@ -176,6 +183,26 @@ class DataParallelTrainer:
         self._state, loss = self._train_step(state, features, labels, mask)
         self._host_step += 1
         return loss
+
+    def train_step_local(self, features, labels, mask):
+        """Collective-mode entry: `features`/`labels`/`mask` are this
+        process's equal-size slice of the global batch (pre-padded by the
+        caller); all processes must call this in lockstep."""
+        state = self.ensure_initialized(features)
+        features = shd.assemble_global_batch(features, self._mesh)
+        labels = shd.assemble_global_batch(labels, self._mesh)
+        mask = shd.assemble_global_batch(np.asarray(mask, np.float32), self._mesh)
+        self._state, loss = self._train_step(state, features, labels, mask)
+        self._host_step += 1
+        return loss
+
+    def eval_step_local(self, features):
+        """Collective-mode eval: local slice in, FULL global outputs out
+        (host numpy, identical on every process)."""
+        state = self.ensure_initialized(features)
+        features = shd.assemble_global_batch(features, self._mesh)
+        outputs = self._eval_step(state, features)
+        return shd.gather_to_host(outputs)
 
     def eval_step(self, features):
         state = self.ensure_initialized(features)

@@ -1,0 +1,181 @@
+"""Worker-side elastic world membership and collective task flow.
+
+Parity: the reference's elastic-Horovod worker path
+(worker/allreduce_trainer.py + master rendezvous, SURVEY.md §3.4): workers
+ask the master `get_comm_rank`, join the communicator, and re-join when
+membership changes.  TPU design: "the communicator" is a jax.distributed
+world + Mesh; joining = `jax.distributed.initialize` with the assigned
+(rank, world, coordinator).  A member death fatally kills the whole world
+(see master/pod_manager.py), so re-join happens in a fresh process after
+the pod manager re-forms the world — this module is what that fresh
+process runs.
+
+Task flow in a multi-process world: rank 0 pulls tasks from the master and
+broadcasts them to all ranks as a tiny fixed-shape collective; every rank
+processes its contiguous slice of each *global* minibatch, so all ranks
+execute the same number of (collective) train steps per task — the lockstep
+invariant jit-compiled SPMD requires.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger("parallel.elastic")
+
+
+@dataclass
+class WorldInfo:
+    rank: int
+    world_size: int
+    rendezvous_id: int
+    coordinator_addr: str
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == 0
+
+
+def join_world(
+    master_client,
+    poll_interval_s: float = 0.5,
+    timeout_s: float = 300.0,
+    initialization_timeout_s: int = 120,
+) -> WorldInfo:
+    """Poll the master rendezvous until this worker has a rank, then join
+    the jax.distributed world (no-op for world_size == 1)."""
+    deadline = time.time() + timeout_s
+    while True:
+        resp = master_client.get_comm_rank()
+        if resp.rank_id >= 0 and resp.world_size > 0:
+            break
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"Worker {master_client.worker_id} never received a rank "
+                f"(last world_size={resp.world_size})"
+            )
+        time.sleep(poll_interval_s)
+    info = WorldInfo(
+        rank=resp.rank_id,
+        world_size=resp.world_size,
+        rendezvous_id=resp.rendezvous_id,
+        coordinator_addr=resp.coordinator_addr,
+    )
+    if info.world_size > 1:
+        import jax
+
+        logger.info(
+            "Joining world %d: rank %d/%d via %s",
+            info.rendezvous_id,
+            info.rank,
+            info.world_size,
+            info.coordinator_addr,
+        )
+        jax.distributed.initialize(
+            coordinator_address=info.coordinator_addr,
+            num_processes=info.world_size,
+            process_id=info.rank,
+            initialization_timeout=initialization_timeout_s,
+        )
+    return info
+
+
+# ---------------------------------------------------------------------------
+# Task broadcast: rank 0 is the only master-facing rank for task dispatch.
+# ---------------------------------------------------------------------------
+
+_TASK_ENC_LEN = 7  # task_id, shard_idx, start, end, type, model_version, epoch
+
+
+def _encode_task(task: Optional[pb.Task], shard_names: List[str]) -> np.ndarray:
+    if task is None:
+        return np.full((_TASK_ENC_LEN,), -1, np.int64)
+    shard_idx = shard_names.index(task.shard_name) if task.shard_name else -1
+    return np.asarray(
+        [task.task_id, shard_idx, task.start, task.end, task.type,
+         task.model_version, task.epoch],
+        np.int64,
+    )
+
+
+def _decode_task(arr: np.ndarray, shard_names: List[str]) -> pb.Task:
+    task_id, shard_idx, start, end, type_, version, epoch = (int(v) for v in arr)
+    return pb.Task(
+        task_id=task_id,
+        shard_name=shard_names[shard_idx] if shard_idx >= 0 else "",
+        start=start,
+        end=end,
+        type=type_,
+        model_version=version,
+        epoch=epoch,
+    )
+
+
+def broadcast_task(
+    task: Optional[pb.Task], shard_names: List[str], world: WorldInfo
+) -> pb.Task:
+    """All ranks call this; rank 0 supplies the task, everyone returns it.
+
+    `shard_names` must be identical (same order) on every rank — it comes
+    from the deterministic data reader shard listing each rank builds.
+    """
+    if world.world_size == 1:
+        assert task is not None
+        return task
+    from jax.experimental import multihost_utils
+
+    encoded = multihost_utils.broadcast_one_to_all(
+        _encode_task(task, shard_names), is_source=world.is_leader
+    )
+    return _decode_task(np.asarray(encoded), shard_names)
+
+
+# ---------------------------------------------------------------------------
+# Lockstep global batching.
+# ---------------------------------------------------------------------------
+
+def iter_local_batch_ranges(
+    task_start: int,
+    task_end: int,
+    per_rank_batch: int,
+    world: WorldInfo,
+) -> Iterator[Tuple[int, int, int]]:
+    """Yield (lo, hi, global_real) for this rank, one tuple per global step.
+
+    Global batch b covers records [task_start + b*W*B, ...); rank r's slice
+    is the r-th contiguous B-record chunk of it.  Every rank yields the same
+    number of tuples (possibly with empty [lo, lo) slices at the ragged
+    tail), preserving the lockstep-collective invariant; `global_real` is
+    the batch's real record count across all ranks (for masking/metrics).
+    """
+    total = task_end - task_start
+    global_batch = per_rank_batch * world.world_size
+    n_steps = max(1, -(-total // global_batch)) if total > 0 else 0
+    for b in range(n_steps):
+        g_lo = task_start + b * global_batch
+        g_hi = min(g_lo + global_batch, task_end)
+        lo = min(g_lo + world.rank * per_rank_batch, g_hi)
+        hi = min(lo + per_rank_batch, g_hi)
+        yield lo, hi, g_hi - g_lo
+
+
+def per_rank_real_counts(
+    global_real: int, per_rank_batch: int, world_size: int
+) -> List[int]:
+    """How many real (non-pad) rows each rank contributed to a global batch
+    (deterministically reconstructible by any rank — used to strip padding
+    from gathered eval outputs)."""
+    counts = []
+    remaining = global_real
+    for _ in range(world_size):
+        take = min(per_rank_batch, max(0, remaining))
+        counts.append(take)
+        remaining -= take
+    return counts

@@ -46,7 +46,9 @@ def pad_batch(tree: Any, multiple: int) -> Tuple[Any, np.ndarray]:
     if not leaves:
         return tree, np.zeros((0,), np.float32)
     batch = leaves[0].shape[0]
-    padded = -(-batch // multiple) * multiple
+    # An empty local slice (possible at a ragged tail in a multi-process
+    # world) still pads up to one full block so shapes agree across ranks.
+    padded = -(-batch // multiple) * multiple if batch else multiple
     mask = np.ones((padded,), np.float32)
     mask[batch:] = 0.0
     if padded == batch:
@@ -54,6 +56,8 @@ def pad_batch(tree: Any, multiple: int) -> Tuple[Any, np.ndarray]:
 
     def pad(x):
         x = np.asarray(x)
+        if batch == 0:
+            return np.zeros((padded,) + x.shape[1:], x.dtype)
         pad_rows = np.repeat(x[:1], padded - batch, axis=0)
         return np.concatenate([x, pad_rows], axis=0)
 
@@ -66,3 +70,53 @@ def shard_batch(tree: Any, mesh):
 
     sharding = batch_sharded(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def put_replicated(tree: Any, mesh):
+    """Replicate a host pytree over the mesh — works in multi-process
+    worlds too (each process places its local shards from its own copy)."""
+    import jax
+
+    sharding = replicated(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+
+    def put(x):
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    return jax.tree.map(put, tree)
+
+
+def assemble_global_batch(tree: Any, mesh):
+    """Turn per-process local batch arrays into the global data-sharded
+    batch.  Single process: a plain device_put of the host-global batch.
+    Multi-process: each process contributes its contiguous slice (all
+    processes must pass equal-size local arrays)."""
+    import jax
+
+    sharding = batch_sharded(mesh)
+    if jax.process_count() == 1:
+        return jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), sharding), tree
+        )
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(x)
+        ),
+        tree,
+    )
+
+
+def gather_to_host(tree: Any):
+    """Fetch possibly process-sharded device arrays as full host arrays
+    (allgathers across processes when needed)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(np.asarray, multihost_utils.process_allgather(tree, tiled=True))

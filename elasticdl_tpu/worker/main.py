@@ -18,18 +18,88 @@ logger = get_logger("worker.main")
 
 
 def main(argv=None):
+    import os
+
+    # The host environment may force-select its accelerator platform at
+    # interpreter start (sitecustomize), overriding JAX_PLATFORMS; honor an
+    # explicit override before any backend initializes (multi-process CPU
+    # worlds in tests/single-host runs depend on it).
+    forced = os.environ.get("ELASTICDL_FORCE_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
     args = parse_worker_args(argv)
     model_spec = load_model_spec(args)
     data_reader = build_data_reader(args, model_spec, args.training_data)
+    validation_reader = (
+        build_data_reader(args, model_spec, args.validation_data)
+        if args.validation_data
+        else None
+    )
+    prediction_reader = (
+        build_data_reader(args, model_spec, args.prediction_data)
+        if args.prediction_data
+        else None
+    )
     client = MasterClient(args.master_addr, worker_id=args.worker_id)
-    worker = Worker(
+    if args.distribution_strategy in (
+        "AllreduceStrategy",
+        "ParameterServerStrategy",
+    ):
+        worker = _build_collective_worker(
+            args, model_spec, data_reader, client,
+            validation_reader, prediction_reader,
+        )
+    else:
+        worker = Worker(
+            master_client=client,
+            model_spec=model_spec,
+            data_reader=data_reader,
+            minibatch_size=args.minibatch_size,
+            validation_data_reader=validation_reader,
+            prediction_data_reader=prediction_reader,
+        )
+    worker.run()
+    return 0
+
+
+def _build_collective_worker(
+    args, model_spec, data_reader, client,
+    validation_reader=None, prediction_reader=None,
+):
+    """Join the elastic world, build the mesh-wide trainer, restore state."""
+    from elasticdl_tpu.checkpoint import CheckpointSaver
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+    from elasticdl_tpu.parallel.elastic import join_world
+    from elasticdl_tpu.worker.collective_worker import CollectiveWorker
+
+    world = join_world(client)
+    mesh = build_mesh(MeshConfig())  # all devices of the joined world
+    trainer = DataParallelTrainer(
+        model=model_spec.build_model(),
+        loss_fn=model_spec.loss,
+        optimizer=model_spec.optimizer(),
+        mesh=mesh,
+    )
+    saver = (
+        CheckpointSaver(args.checkpoint_dir, keep_max=args.keep_checkpoint_max)
+        if args.checkpoint_dir
+        else None
+    )
+    return CollectiveWorker(
         master_client=client,
         model_spec=model_spec,
         data_reader=data_reader,
         minibatch_size=args.minibatch_size,
+        world=world,
+        trainer=trainer,
+        checkpoint_saver=saver,
+        checkpoint_steps=args.checkpoint_steps,
+        validation_data_reader=validation_reader,
+        prediction_data_reader=prediction_reader,
     )
-    worker.run()
-    return 0
 
 
 if __name__ == "__main__":

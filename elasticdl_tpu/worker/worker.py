@@ -35,12 +35,26 @@ class Worker:
         report_version_every_steps: int = 20,
         wait_sleep_s: float = 0.5,
         max_consecutive_task_failures: int = 10,
+        validation_data_reader=None,
+        prediction_data_reader=None,
     ):
         self._mc = master_client
         self._spec = model_spec
         self._minibatch_size = minibatch_size
         self._task_data_service = TaskDataService(
             data_reader, model_spec.dataset_fn
+        )
+        # Evaluation/prediction tasks read from their own data source when
+        # one is configured (shard names address a different dataset).
+        self._eval_data_service = (
+            TaskDataService(validation_data_reader, model_spec.dataset_fn)
+            if validation_data_reader is not None
+            else self._task_data_service
+        )
+        self._predict_data_service = (
+            TaskDataService(prediction_data_reader, model_spec.dataset_fn)
+            if prediction_data_reader is not None
+            else self._task_data_service
         )
         self._trainer = trainer or Trainer(
             model=model_spec.build_model(),
@@ -101,7 +115,12 @@ class Worker:
     def _get_batches(self, task, mode: str):
         # The user's dataset_fn parses/shuffles records; the worker applies
         # the job-level minibatch batching (reference worker behavior).
-        dataset = self._task_data_service.get_dataset(task, mode)
+        service = {
+            Mode.TRAINING: self._task_data_service,
+            Mode.EVALUATION: self._eval_data_service,
+            Mode.PREDICTION: self._predict_data_service,
+        }[mode]
+        dataset = service.get_dataset(task, mode)
         return dataset.batch(self._minibatch_size)
 
     def _process_train_task(self, task) -> dict:

@@ -1,0 +1,108 @@
+"""Unit tests for elastic membership, checkpointing, and lockstep batching."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.checkpoint import CheckpointSaver
+from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
+from elasticdl_tpu.parallel import elastic
+from elasticdl_tpu.parallel.elastic import WorldInfo
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+class TestRendezvous:
+    def test_rank_assignment_and_bump(self):
+        rdv = ElasticRendezvous(coordinator_port_fn=lambda host: 5000)
+        rid = rdv.set_worker_hosts([(2, "hostb"), (0, "hosta")])
+        assert rid == 1
+        resp = rdv.get_comm_rank(0)
+        assert resp.rank_id == 0 and resp.world_size == 2
+        assert resp.coordinator_addr == "hosta:5000"
+        assert rdv.get_comm_rank(2).rank_id == 1
+        # Unknown worker: rank -1 (not in this world).
+        assert rdv.get_comm_rank(7).rank_id == -1
+        # Churn: new world, new id; old member evicted.
+        rid2 = rdv.set_worker_hosts([(3, "hostc")])
+        assert rid2 == 2
+        assert rdv.get_comm_rank(0).rank_id == -1
+        assert rdv.get_comm_rank(3).rank_id == 0
+
+    def test_liveness_reports_stale_world(self):
+        rdv = ElasticRendezvous(coordinator_port_fn=lambda host: 5000)
+        rid = rdv.set_worker_hosts([(0, "h")])
+        assert rdv.report_liveness(0, "h", rid) is False
+        rdv.set_worker_hosts([(0, "h"), (1, "h")])
+        assert rdv.report_liveness(0, "h", rid) is True  # stale rendezvous
+
+
+class TestCheckpointSaver:
+    def test_save_load_roundtrip_and_gc(self, tmp_path):
+        saver = CheckpointSaver(str(tmp_path), keep_max=2)
+        assert saver.load_latest() == (None, 0)
+        for step in (10, 20, 30):
+            saver.save({"w": np.full((3,), step)}, step)
+        assert saver.steps() == [20, 30]  # keep_max trimmed step 10
+        state, step = saver.load_latest()
+        assert step == 30
+        np.testing.assert_array_equal(state["w"], [30, 30, 30])
+
+    def test_corrupt_latest_falls_back(self, tmp_path):
+        saver = CheckpointSaver(str(tmp_path), keep_max=3)
+        saver.save({"w": np.ones(2)}, 1)
+        saver.save({"w": np.ones(2) * 2}, 2)
+        # Corrupt the newest snapshot.
+        with open(tmp_path / "step_000000000002" / "state.pkl", "wb") as f:
+            f.write(b"garbage")
+        state, step = saver.load_latest()
+        assert step == 1
+
+
+class TestTaskBroadcastEncoding:
+    def test_roundtrip(self):
+        shard_names = ["a", "b"]
+        task = pb.Task(
+            task_id=7, shard_name="b", start=5, end=25, type=pb.EVALUATION,
+            model_version=3, epoch=1,
+        )
+        arr = elastic._encode_task(task, shard_names)
+        back = elastic._decode_task(arr, shard_names)
+        assert back == task
+
+    def test_none_encodes_no_task(self):
+        arr = elastic._encode_task(None, ["a"])
+        back = elastic._decode_task(arr, ["a"])
+        assert back.task_id == -1 and back.shard_name == ""
+
+
+class TestLockstepBatching:
+    def test_even_split(self):
+        world = WorldInfo(rank=1, world_size=2, rendezvous_id=1, coordinator_addr="")
+        ranges = list(elastic.iter_local_batch_ranges(0, 16, 4, world))
+        # Global batches of 8: [0,8) and [8,16); rank 1 takes second halves.
+        assert ranges == [(4, 8, 8), (12, 16, 8)]
+
+    def test_ragged_tail_same_step_count_across_ranks(self):
+        # 18 records, per-rank batch 4, world 2 -> global batch 8 -> 3 steps.
+        for rank in (0, 1):
+            world = WorldInfo(rank=rank, world_size=2, rendezvous_id=1,
+                              coordinator_addr="")
+            ranges = list(elastic.iter_local_batch_ranges(100, 118, 4, world))
+            assert len(ranges) == 3
+        r0 = list(elastic.iter_local_batch_ranges(100, 118, 4,
+                  WorldInfo(0, 2, 1, "")))
+        r1 = list(elastic.iter_local_batch_ranges(100, 118, 4,
+                  WorldInfo(1, 2, 1, "")))
+        # Tail global batch holds records [116,118): rank0 gets both, rank1 none.
+        assert r0[-1] == (116, 118, 2)
+        assert r1[-1] == (118, 118, 2)
+        # Together the ranks cover the task exactly once.
+        covered = []
+        for (lo, hi, _), (lo1, hi1, _) in zip(r0, r1):
+            covered.extend(range(lo, hi))
+            covered.extend(range(lo1, hi1))
+        assert sorted(covered) == list(range(100, 118))
+
+    def test_per_rank_real_counts(self):
+        assert elastic.per_rank_real_counts(8, 4, 2) == [4, 4]
+        assert elastic.per_rank_real_counts(5, 4, 2) == [4, 1]
+        assert elastic.per_rank_real_counts(2, 4, 2) == [2, 0]

@@ -130,3 +130,38 @@ def test_per_epoch_eval_and_train_end_callbacks(tmp_path, monkeypatch):
     # 2 epoch boundaries (after epochs 0 and 1) + 1 final round.
     assert len(rounds) == 3
     assert len(ran) == 1  # train-end callback ran exactly once
+
+
+def test_eval_tasks_read_from_validation_reader():
+    """EVALUATION tasks must read the validation dataset, not re-read the
+    training shards that happen to share names."""
+    import numpy as np
+
+    from elasticdl_tpu.data.reader import NumpyDataReader
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.worker.worker import Worker
+
+    train_reader = NumpyDataReader(
+        np.zeros((8, 2), np.float32), np.zeros(8, np.int32), shard_name="d"
+    )
+    val_reader = NumpyDataReader(
+        np.ones((8, 2), np.float32), np.ones(8, np.int32), shard_name="d"
+    )
+
+    class Spec:
+        dataset_fn = staticmethod(lambda ds, mode, meta: ds)
+
+    worker = Worker.__new__(Worker)  # wire only what _get_batches needs
+    from elasticdl_tpu.data.task_data_service import TaskDataService
+
+    worker._minibatch_size = 4
+    worker._task_data_service = TaskDataService(train_reader, Spec.dataset_fn)
+    worker._eval_data_service = TaskDataService(val_reader, Spec.dataset_fn)
+    worker._predict_data_service = worker._task_data_service
+    task = pb.Task(task_id=1, shard_name="d", start=0, end=8, type=pb.EVALUATION)
+    from elasticdl_tpu.common.constants import Mode
+
+    batches = list(worker._get_batches(task, Mode.EVALUATION))
+    assert all(np.all(f == 1.0) for f, _l in batches)
+    train_batches = list(worker._get_batches(task, Mode.TRAINING))
+    assert all(np.all(f == 0.0) for f, _l in train_batches)

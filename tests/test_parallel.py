@@ -108,3 +108,35 @@ def test_collective_allreduce_and_barrier():
     status, same = comm.broadcast(np.array([3.0]))
     assert status == CollectiveResult.SUCCEEDED
     np.testing.assert_allclose(same, [3.0])
+
+
+def test_local_block_rounds_to_device_multiple():
+    mesh = build_mesh(MeshConfig())  # 8 devices, 1 process
+    dp = DataParallelTrainer(
+        zoo.custom_model(), zoo.loss, zoo.optimizer(), mesh, seed=0
+    )
+    assert dp.local_block(10) == 16
+    assert dp.local_block(8) == 8
+    assert dp.local_block(1) == 8
+
+
+def test_train_step_local_indivisible_minibatch():
+    """minibatch 10 on an 8-device mesh: caller pads to local_block(10)=16
+    with a mask; result must match single-device training on the 10 real
+    rows."""
+    from elasticdl_tpu.parallel import sharding as shd
+
+    mesh = build_mesh(MeshConfig())
+    dp = DataParallelTrainer(
+        zoo.custom_model(), zoo.loss, zoo.optimizer(), mesh, seed=0
+    )
+    single = Trainer(zoo.custom_model(), zoo.loss, zoo.optimizer(), seed=0)
+    rng = np.random.RandomState(3)
+    feats = rng.rand(10, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, size=10).astype(np.int32)
+    block = dp.local_block(10)
+    pf, mask = shd.pad_batch(feats, block)
+    pl, _ = shd.pad_batch(labels, block)
+    dp_loss = dp.train_step_local(pf, pl, mask)
+    s_loss = single.train_step(feats, labels)
+    np.testing.assert_allclose(float(dp_loss), float(s_loss), rtol=1e-4, atol=1e-5)
