@@ -21,6 +21,7 @@ import os
 import pickle
 import shutil
 import tempfile
+import time
 from typing import Any, Optional, Tuple
 
 from elasticdl_tpu.common.log_utils import get_logger
@@ -44,7 +45,7 @@ class CheckpointSaver:
     def steps(self):
         steps = []
         for name in os.listdir(self._dir):
-            if name.startswith("step_") and not name.endswith(".tmp"):
+            if name.startswith("step_") and ".tmp" not in name:
                 try:
                     steps.append(int(name[len("step_"):]))
                 except ValueError:
@@ -89,3 +90,10 @@ class CheckpointSaver:
         steps = self.steps()
         for step in steps[: -self._keep_max]:
             shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        # Orphaned tmp dirs from saves interrupted by preemption (the very
+        # scenario checkpoints exist for) would otherwise pile up forever.
+        for name in os.listdir(self._dir):
+            if name.startswith("step_") and ".tmp" in name:
+                path = os.path.join(self._dir, name)
+                if time.time() - os.path.getmtime(path) > 300:
+                    shutil.rmtree(path, ignore_errors=True)

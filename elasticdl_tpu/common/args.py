@@ -108,6 +108,11 @@ def add_cluster_arguments(parser: argparse.ArgumentParser):
         "--need_elasticity", type=str2bool, nargs="?", const=True, default=True
     )
     parser.add_argument(
+        "--worker_liveness_timeout_s", type=non_neg_int, default=60,
+        help="Kill+relaunch a worker whose heartbeat is silent this long "
+        "(0 disables hung-worker detection)",
+    )
+    parser.add_argument(
         "--devices_per_worker", type=pos_int, default=1,
         help="TPU chips visible to each worker host (mesh = workers x devices)",
     )

@@ -57,6 +57,7 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
             f"{args.job_name}_worker_logs",
         ),
         job_finished_fn=master.task_manager.finished,
+        liveness_timeout_s=args.worker_liveness_timeout_s,
     )
     master.pod_manager = manager  # type: ignore[attr-defined]
     try:

@@ -61,6 +61,7 @@ class LocalProcessManager:
         log_dir: str = "",
         job_finished_fn: Optional[Callable[[], bool]] = None,
         poll_interval_s: float = 0.2,
+        liveness_timeout_s: float = 0.0,
     ):
         self._num_workers = num_workers
         self._worker_argv_fn = worker_argv_fn
@@ -71,6 +72,7 @@ class LocalProcessManager:
         self._log_dir = log_dir
         self._job_finished_fn = job_finished_fn
         self._poll_interval_s = poll_interval_s
+        self._liveness_timeout_s = liveness_timeout_s
 
         self._lock = threading.Lock()
         self._procs: List[WorkerProcess] = []
@@ -146,6 +148,8 @@ class LocalProcessManager:
 
     def _launch_world(self, n: int):
         with self._lock:
+            if self._stopped:
+                return
             worker_ids = list(range(self._next_worker_id, self._next_worker_id + n))
             self._next_worker_id += n
         if self._rendezvous is not None:
@@ -169,7 +173,14 @@ class LocalProcessManager:
             procs.append(WorkerProcess(wid, popen, log_path))
             logger.info("Launched worker %d (pid %d)", wid, popen.pid)
         with self._lock:
-            self._procs = procs
+            if self._stopped:
+                # stop() raced the launch; don't leak the new processes.
+                stale = procs
+                procs = []
+            else:
+                self._procs = procs
+                stale = []
+        self._terminate_procs(stale)
 
     def _terminate_procs(self, procs: List[WorkerProcess]):
         for wp in procs:
@@ -195,12 +206,25 @@ class LocalProcessManager:
         return bool(self._job_finished_fn and self._job_finished_fn())
 
     def _monitor_loop(self):
+        try:
+            self._monitor_loop_inner()
+        except Exception as exc:  # never die silently: wait() must unblock
+            logger.exception("Pod-manager monitor crashed")
+            self._failed_reason = f"pod-manager monitor crashed: {exc}"
+            with self._lock:
+                self._stopped = True
+                procs = list(self._procs)
+            self._terminate_procs(procs)
+            self._done_event.set()
+
+    def _monitor_loop_inner(self):
         while True:
             time.sleep(self._poll_interval_s)
             with self._lock:
                 if self._stopped:
                     return
                 procs = list(self._procs)
+            self._kill_stale_workers(procs)
             exited = [(wp, wp.popen.poll()) for wp in procs]
             exited = [(wp, code) for wp, code in exited if code is not None]
             if not exited:
@@ -217,6 +241,30 @@ class LocalProcessManager:
                 logger.info("All workers exited; job done")
                 self._done_event.set()
                 return
+
+    def _kill_stale_workers(self, procs: List[WorkerProcess]):
+        """Hung-worker detection: a worker whose heartbeat went silent is
+        killed so the normal churn path re-forms the world (process exit is
+        the only signal the monitor reacts to; this converts 'wedged but
+        alive' into it)."""
+        if (
+            self._liveness_timeout_s <= 0
+            or self._rendezvous is None
+            or self._job_finished()
+        ):
+            return
+        stale = set(self._rendezvous.stale_workers(self._liveness_timeout_s))
+        for wp in procs:
+            if wp.worker_id in stale and wp.popen.poll() is None:
+                logger.warning(
+                    "Worker %d heartbeat stale > %.0fs; killing it",
+                    wp.worker_id,
+                    self._liveness_timeout_s,
+                )
+                try:
+                    wp.popen.kill()
+                except ProcessLookupError:
+                    pass
 
     def _handle_churn(self, procs: List[WorkerProcess], crashed):
         """One churn event: any worker death invalidates the whole world."""

@@ -87,6 +87,48 @@ def join_world(
     return info
 
 
+class HeartbeatReporter:
+    """Background liveness heartbeats to the master (failure-detection
+    plane: the pod manager kills workers whose heartbeats go silent, which
+    converts hangs into the process-exit signal churn handling reacts to)."""
+
+    def __init__(
+        self,
+        master_client,
+        world: WorldInfo,
+        host: str = "127.0.0.1",
+        interval_s: float = 5.0,
+    ):
+        import threading
+
+        self._mc = master_client
+        self._world = world
+        self._host = host
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="worker-heartbeat", daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval_s):
+            try:
+                self._mc.report_worker_liveness(
+                    self._host, self._world.rendezvous_id
+                )
+            except Exception:
+                # Master unreachable: nothing useful to do from here; the
+                # process manager side handles the failure.
+                pass
+
+
 # ---------------------------------------------------------------------------
 # Task broadcast: rank 0 is the only master-facing rank for task dispatch.
 # ---------------------------------------------------------------------------

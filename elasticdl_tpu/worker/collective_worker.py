@@ -101,6 +101,13 @@ class CollectiveWorker:
             )
 
     def run(self):
+        heartbeat = elastic.HeartbeatReporter(self._mc, self._world).start()
+        try:
+            self._run_task_loop()
+        finally:
+            heartbeat.stop()
+
+    def _run_task_loop(self):
         self.restore_from_checkpoint()
         while True:
             task = self._mc.get_task() if self._world.is_leader else None

@@ -1,0 +1,123 @@
+"""CIFAR-10 ResNet-20 — model-zoo contract, JAX/flax body.
+
+Parity: model_zoo/cifar10_functional_api.py in the reference (a Keras
+functional-API ResNet-20-style CNN for CIFAR-10; BASELINE config 2).  Same
+contract functions, TPU-first body: 3x3 convs lower onto the MXU, batch
+norm state rides the TrainState's mutable collections, bfloat16 compute
+with float32 params/accumulators (the standard TPU mixed-precision recipe).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from model_zoo import datasets
+
+Dtype = Any
+
+
+class ResidualBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet20(nn.Module):
+    """Classic 6n+2 CIFAR ResNet with n=3 (16/32/64 filters)."""
+
+    num_classes: int = 10
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        for filters, strides in ((16, 1), (32, 2), (64, 2)):
+            for block_index in range(3):
+                x = ResidualBlock(
+                    filters, strides if block_index == 0 else 1, self.dtype
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model(num_classes: int = 10, use_bf16: bool = True):
+    return ResNet20(
+        num_classes=num_classes,
+        dtype=jnp.bfloat16 if use_bf16 else jnp.float32,
+    )
+
+
+def loss(labels, predictions):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions.astype(jnp.float32), labels.astype(jnp.int32)
+    ).mean()
+
+
+def optimizer(lr: float = 0.1):
+    return optax.sgd(lr, momentum=0.9, nesterov=True)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def parse(record):
+        image, label = record
+        image = np.asarray(image, np.float32) / 255.0
+        # Per-channel CIFAR-10 normalization constants.
+        image = (image - np.asarray([0.4914, 0.4822, 0.4465], np.float32)) / (
+            np.asarray([0.247, 0.243, 0.261], np.float32)
+        )
+        return image, np.int32(label)
+
+    dataset = dataset.map(parse)
+    if mode == "training":
+        dataset = dataset.shuffle(2048, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda outputs, labels: np.mean(
+            np.argmax(outputs, axis=1) == labels.astype(np.int64)
+        ),
+        "loss": lambda outputs, labels: float(
+            loss(jnp.asarray(labels), jnp.asarray(outputs))
+        ),
+    }
+
+
+def custom_data_reader(data_path: str, **kwargs):
+    name, params = datasets.parse_synthetic_path(data_path)
+    if name is None:
+        return None
+    return datasets.synthetic_cifar10_reader(
+        n=params.get("n", 4096), seed=params.get("seed", 0)
+    )

@@ -43,6 +43,21 @@ def synthetic_mnist_reader(n: int = 4096, seed: int = 0, shard_name="mnist-synth
     return NumpyDataReader(images, labels, shard_name=shard_name)
 
 
+def synthetic_cifar10_reader(n: int = 4096, seed: int = 0, shard_name="cifar-synth"):
+    """CIFAR-shaped learnable synthetic data: 32x32x3 uint8 images with a
+    class-dependent colored patch, so accuracy is genuinely learnable."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    images = rng.integers(0, 64, size=(n, 32, 32, 3)).astype(np.uint8)
+    for cls in range(10):
+        rows = (cls // 5) * 16 + 3
+        cols = (cls % 5) * 6 + 1
+        channel = cls % 3
+        mask = labels == cls
+        images[mask, rows : rows + 8, cols : cols + 6, channel] = 220
+    return NumpyDataReader(images, labels, shard_name=shard_name)
+
+
 def synthetic_classification_reader(
     n: int, num_features: int, num_classes: int, seed: int = 0, shard_name="synth"
 ):

@@ -106,3 +106,56 @@ class TestLockstepBatching:
         assert elastic.per_rank_real_counts(8, 4, 2) == [4, 4]
         assert elastic.per_rank_real_counts(5, 4, 2) == [4, 1]
         assert elastic.per_rank_real_counts(2, 4, 2) == [2, 0]
+
+
+class TestHungWorkerDetection:
+    def test_stale_heartbeat_triggers_churn(self):
+        """A worker that never heartbeats gets killed and the churn path
+        runs (here: budget 0, world 1 -> job fails rather than hangs)."""
+        import sys
+        import time
+
+        from elasticdl_tpu.master.pod_manager import LocalProcessManager
+
+        rdv = ElasticRendezvous(coordinator_port_fn=lambda host: 5000)
+        manager = LocalProcessManager(
+            num_workers=1,
+            worker_argv_fn=lambda wid: [sys.executable, "-c",
+                                        "import time; time.sleep(600)"],
+            rendezvous=rdv,
+            max_restarts=0,
+            liveness_timeout_s=0.5,
+            poll_interval_s=0.1,
+        )
+        try:
+            manager.start()
+            ok = manager.wait(timeout=30)
+            assert ok is False
+            assert "restart budget" in manager.failed_reason
+        finally:
+            manager.stop()
+
+    def test_monitor_crash_unblocks_wait(self):
+        import sys
+
+        from elasticdl_tpu.master.pod_manager import LocalProcessManager
+
+        class BoomRendezvous(ElasticRendezvous):
+            def stale_workers(self, timeout_s):
+                raise RuntimeError("boom")
+
+        manager = LocalProcessManager(
+            num_workers=1,
+            worker_argv_fn=lambda wid: [sys.executable, "-c",
+                                        "import time; time.sleep(600)"],
+            rendezvous=BoomRendezvous(coordinator_port_fn=lambda host: 5000),
+            max_restarts=0,
+            liveness_timeout_s=1.0,
+            poll_interval_s=0.1,
+        )
+        try:
+            manager.start()
+            ok = manager.wait(timeout=30)
+            assert ok is False and "crashed" in manager.failed_reason
+        finally:
+            manager.stop()
