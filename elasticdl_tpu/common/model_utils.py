@@ -27,6 +27,10 @@ class ModelSpec:
     eval_metrics_fn: Optional[Callable] = None
     callbacks: Optional[Callable] = None
     custom_data_reader: Optional[Callable] = None
+    # Optional: returns a parallel.sparse_optim.SparseOptimizer for the
+    # model's sharded embedding tables (PS mode; reference: the Go PS ran
+    # one optimizer for dense+sparse, here the sparse path is explicit).
+    embedding_optimizer: Optional[Callable] = None
     model_params: dict = field(default_factory=dict)
 
     def build_model(self):
@@ -74,5 +78,6 @@ def load_model_spec(args) -> ModelSpec:
         eval_metrics_fn=optional(args.eval_metrics_fn),
         callbacks=optional(args.callbacks),
         custom_data_reader=optional(args.custom_data_reader),
+        embedding_optimizer=optional("embedding_optimizer"),
         model_params=parse_dict_params(args.model_params),
     )

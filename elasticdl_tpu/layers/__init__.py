@@ -1,0 +1,1 @@
+from elasticdl_tpu.layers.embedding import Embedding  # noqa: F401

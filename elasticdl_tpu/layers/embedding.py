@@ -1,0 +1,105 @@
+"""The sharded-embedding layer: PS-mode's data plane, compiled.
+
+Parity: `elasticdl.layers.Embedding`
+(elasticdl/python/elasticdl/layers/embedding.py in the reference).  There,
+the layer pulls rows from the parameter-server pods outside autodiff,
+`tape.watch`es the looked-up batch-embedding tensor, and the worker pushes
+the tensor's gradient back as IndexedSlices for the PS's sparse optimizer
+kernels.
+
+TPU-native translation of each piece:
+
+- PS-partitioned table            -> one flax param per layer, marked
+  `nn.with_partitioning` on the VOCAB_AXIS; the trainer maps that logical
+  axis across the WHOLE mesh, so a table's rows spread over every chip's
+  HBM (the capacity story of the PS, without the gRPC hop).
+- pull_embedding_vectors          -> a gather on the sharded table inside
+  the jit step; XLA lowers it to on-chip gathers + ICI collectives.
+- tape.watch(bet) + IndexedSlices -> `self.perturb(...)`: a zeros variable
+  added to the looked-up activations.  Autodiff gives the activation
+  gradient at that point WITHOUT differentiating through the (huge) table
+  — the lookup itself is wrapped in stop_gradient, so no dense
+  [vocab, dim] cotangent ever exists.
+- push_gradients (sparse apply)   -> the trainer scatter-applies
+  (ids, activation-grads) with the sparse row-wise optimizers in
+  elasticdl_tpu/parallel/sparse_optim.py (the Eigen kernel parity surface).
+
+The layer `sow`s its ids each call so the trainer can pair them with the
+perturbation gradients.  One `__call__` per layer instance per step (same
+restriction as the reference layer).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# Logical axis name for table rows; the PS/sharded trainer maps it to the
+# physical mesh (all axes), everything else replicates.
+VOCAB_AXIS = "embedding_vocab"
+# Variable collections used to smuggle ids/activation-grads per step.
+IDS_COLLECTION = "embedding_ids"
+PERTURBATIONS = "perturbations"
+
+
+def default_embedding_init(key, shape, dtype=jnp.float32):
+    # Matches the reference's default 'uniform' Keras initializer scale.
+    return jax.random.uniform(key, shape, dtype, -0.05, 0.05)
+
+
+class Embedding(nn.Module):
+    """Vocab-sharded embedding lookup with sparse-gradient capture.
+
+    ids: int array [batch] or [batch, length]; negative ids are treated as
+    padding (contribute zeros, receive no gradient).
+    combiner: None returns per-position vectors [..., dim]; 'sum'/'mean'
+    reduce the trailing length axis (the reference's sparse-input combiner).
+    """
+
+    vocab_size: int
+    embedding_dim: int
+    combiner: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+    embeddings_initializer: Callable = default_embedding_init
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.param(
+            "embedding",
+            nn.with_partitioning(
+                self.embeddings_initializer, (VOCAB_AXIS, None)
+            ),
+            (self.vocab_size, self.embedding_dim),
+            self.dtype,
+        )
+        ids = jnp.asarray(ids).astype(jnp.int32)
+        valid = ids >= 0
+        safe_ids = jnp.where(valid, ids, 0)
+        # NOTE: no stop_gradient here. Under the PS-mode trainer the table
+        # is a closure constant of the loss (not a grad argument), so no
+        # dense [vocab, dim] cotangent is ever built — the sparse path owns
+        # the update.  Under the Local/AllReduce trainers the table is a
+        # normal param and trains by dense autodiff (correct for the small
+        # tables those modes are meant for).
+        acts = jnp.take(table, safe_ids, axis=0)
+        # Gradient capture point (the reference's tape.watch(bet)); must sit
+        # BEFORE the validity mask so padding positions get zero gradient.
+        acts = self.perturb("bet", acts)
+        self.sow(IDS_COLLECTION, "ids", safe_ids)
+        acts = acts * valid[..., None].astype(acts.dtype)
+        if self.combiner is None:
+            return acts
+        if ids.ndim < 2:
+            raise ValueError("combiner requires ids of shape [batch, length]")
+        summed = jnp.sum(acts, axis=-2)
+        if self.combiner == "sum":
+            return summed
+        if self.combiner == "mean":
+            counts = jnp.maximum(
+                jnp.sum(valid.astype(acts.dtype), axis=-1, keepdims=True), 1.0
+            )
+            return summed / counts
+        raise ValueError(f"Unknown combiner {self.combiner!r}")

@@ -62,6 +62,7 @@ class LocalProcessManager:
         job_finished_fn: Optional[Callable[[], bool]] = None,
         poll_interval_s: float = 0.2,
         liveness_timeout_s: float = 0.0,
+        startup_grace_s: Optional[float] = None,
     ):
         self._num_workers = num_workers
         self._worker_argv_fn = worker_argv_fn
@@ -73,6 +74,13 @@ class LocalProcessManager:
         self._job_finished_fn = job_finished_fn
         self._poll_interval_s = poll_interval_s
         self._liveness_timeout_s = liveness_timeout_s
+        # Workers only heartbeat after spawn + imports + the distributed-init
+        # barrier; judge never-heartbeated workers against a longer grace.
+        self._startup_grace_s = (
+            startup_grace_s
+            if startup_grace_s is not None
+            else 4 * liveness_timeout_s
+        )
 
         self._lock = threading.Lock()
         self._procs: List[WorkerProcess] = []
@@ -253,7 +261,11 @@ class LocalProcessManager:
             or self._job_finished()
         ):
             return
-        stale = set(self._rendezvous.stale_workers(self._liveness_timeout_s))
+        stale = set(
+            self._rendezvous.stale_workers(
+                self._liveness_timeout_s, self._startup_grace_s
+            )
+        )
         for wp in procs:
             if wp.worker_id in stale and wp.popen.poll() is None:
                 logger.warning(

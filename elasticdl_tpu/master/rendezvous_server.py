@@ -42,7 +42,8 @@ class ElasticRendezvous:
         # worker_id (sorted) -> rank; host of rank 0 hosts the coordinator.
         self._workers: List[Tuple[int, str]] = []  # [(worker_id, host)]
         self._coordinator_addr = ""
-        self._last_heartbeat: Dict[int, float] = {}
+        self._last_heartbeat: Dict[int, Optional[float]] = {}
+        self._world_declared_at = time.time()
 
     # ------------------------------------------------------------------
     # Master/pod-manager side
@@ -64,7 +65,12 @@ class ElasticRendezvous:
                 self._coordinator_addr = f"{rank0_host}:{port}"
             else:
                 self._coordinator_addr = ""
-            self._last_heartbeat = {wid: time.time() for wid, _ in workers}
+            # None until the worker's FIRST heartbeat: staleness for
+            # never-heartbeated workers is judged against the (longer)
+            # startup grace, since world formation (spawn + imports +
+            # distributed init barrier) happens before heartbeats begin.
+            self._world_declared_at = time.time()
+            self._last_heartbeat = {wid: None for wid, _ in workers}
             logger.info(
                 "Rendezvous %d: world_size=%d coordinator=%s workers=%s",
                 self._rendezvous_id,
@@ -83,15 +89,22 @@ class ElasticRendezvous:
         with self._lock:
             return list(self._workers)
 
-    def stale_workers(self, timeout_s: float) -> List[int]:
-        """Workers that have not heartbeated within `timeout_s`."""
+    def stale_workers(
+        self, timeout_s: float, startup_grace_s: Optional[float] = None
+    ) -> List[int]:
+        """Workers whose heartbeat went silent for `timeout_s` — or that
+        never heartbeated within `startup_grace_s` of world declaration."""
+        grace = startup_grace_s if startup_grace_s is not None else timeout_s
         now = time.time()
         with self._lock:
-            return [
-                wid
-                for wid, last in self._last_heartbeat.items()
-                if now - last > timeout_s
-            ]
+            stale = []
+            for wid, last in self._last_heartbeat.items():
+                if last is None:
+                    if now - self._world_declared_at > grace:
+                        stale.append(wid)
+                elif now - last > timeout_s:
+                    stale.append(wid)
+            return stale
 
     # ------------------------------------------------------------------
     # Worker-facing (via servicer)

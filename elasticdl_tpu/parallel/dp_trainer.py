@@ -115,8 +115,13 @@ class DataParallelTrainer:
 
     def ensure_initialized(self, features) -> TrainState:
         if self._state is None:
+            from elasticdl_tpu.worker.trainer import _unbox_partitioned
+
             rng = jax.random.PRNGKey(self._seed)
-            variables = dict(self._model.init(rng, jnp.asarray(features)))
+            variables = dict(
+                self._model.init(rng, jax.tree.map(jnp.asarray, features))
+            )
+            variables = _unbox_partitioned(variables)
             params = variables.pop("params")
             state = TrainState(
                 jnp.zeros((), jnp.int32),
@@ -211,6 +216,11 @@ class DataParallelTrainer:
         outputs = self._eval_step(state, features)
         # Strip padding rows before returning to the host.
         return jax.tree.map(lambda x: np.asarray(x)[:n], outputs)
+
+    def state_to_host(self) -> Optional[TrainState]:
+        """Host-complete snapshot for checkpointing.  All state is fully
+        replicated, so every process can materialize it locally."""
+        return None if self._state is None else jax.device_get(self._state)
 
     def get_variables_numpy(self) -> dict:
         if self._state is None:

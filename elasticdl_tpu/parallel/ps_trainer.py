@@ -1,0 +1,435 @@
+"""Sharded-embedding trainer: ParameterServerStrategy, compiled.
+
+Parity: the reference's PS-mode training stack (SURVEY.md §3.3) — worker
+pulls dense params + embedding rows from Go PS pods, computes grads, and
+pushes dense grads + IndexedSlices back for the PS's Eigen sparse kernels.
+TPU-native: the PS dissolves into the step function.
+
+- Dense params: replicated over the mesh, optax-updated (the PS's dense
+  optimizer path).
+- Embedding tables: ONE array per table, vocab-sharded across ALL mesh
+  devices' HBM (the PS-pod partitioning, minus the gRPC hop).  Lookups are
+  gathers on the sharded operand; XLA lowers them to local gathers + ICI
+  collectives inside the same program as the matmuls.
+- Sparse gradients: captured at each Embedding layer's perturbation point
+  (layers/embedding.py) — never a dense [vocab, dim] cotangent — and
+  scatter-applied by the sparse row-wise optimizers (parallel/sparse_optim).
+
+Same public surface as DataParallelTrainer, so the worker runtimes drive
+either interchangeably.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.layers.embedding import (
+    IDS_COLLECTION,
+    PERTURBATIONS,
+    VOCAB_AXIS,
+)
+from elasticdl_tpu.parallel import sharding as shd
+from elasticdl_tpu.parallel.dp_trainer import per_example_loss_fn
+from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from elasticdl_tpu.parallel.sparse_optim import SparseOptimizer, sgd
+from elasticdl_tpu.worker.trainer import _model_apply
+
+logger = get_logger("parallel.ps_trainer")
+
+
+class PSTrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any           # dense params; table leaves hold scalar placeholders
+    opt_state: Any
+    model_state: Any      # batch_stats etc.
+    tables: Dict[str, jnp.ndarray]          # path-key -> [vocab, dim]
+    slots: Dict[str, Dict[str, jnp.ndarray]]  # path-key -> optimizer slots
+
+
+def _path_key(path) -> str:
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def _unbox(tree):
+    return jax.tree.map(
+        lambda x: x.unbox() if isinstance(x, nn.Partitioned) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )
+
+
+class ShardedEmbeddingTrainer:
+    """PS-mode trainer over an N-device (data, model) mesh."""
+
+    def __init__(
+        self,
+        model,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        mesh,
+        embedding_optimizer: Optional[SparseOptimizer] = None,
+        seed: int = 0,
+    ):
+        self._model = model
+        self._loss_fn = loss_fn
+        self._per_example_loss = per_example_loss_fn(loss_fn)
+        self._tx = optimizer
+        if embedding_optimizer is None:
+            logger.warning(
+                "No embedding_optimizer in the model spec; defaulting to "
+                "sparse SGD(0.01) for embedding tables"
+            )
+            embedding_optimizer = sgd(0.01)
+        self._emb_tx = embedding_optimizer
+        self._mesh = mesh
+        self._seed = seed
+        self._dp = shd.data_axis_size(mesh)
+        self._state: Optional[PSTrainState] = None
+        self._host_step = 0
+        self._perturb_shapes: Dict[str, Any] = {}
+        self._pending_restore: Optional[PSTrainState] = None
+        self._train_step = None  # jitted lazily once shardings are known
+        self._eval_step = None
+
+    # -- public surface (mirrors DataParallelTrainer) -------------------
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def local_block(self, per_rank_batch: int) -> int:
+        local_devices = max(1, self._dp // jax.process_count())
+        return -(-per_rank_batch // local_devices) * local_devices
+
+    @property
+    def state(self) -> Optional[PSTrainState]:
+        return self._state
+
+    @state.setter
+    def state(self, value: PSTrainState):
+        value = PSTrainState(*value)
+        if self._state is None:
+            # Restore before the first batch (checkpoint restore at worker
+            # boot): applied inside ensure_initialized once the model's
+            # structure/shardings exist.
+            self._pending_restore = value
+            self._host_step = int(np.asarray(jax.device_get(value.step)))
+            return
+        self._state = self._place_state(jax.device_get(value))
+        self._host_step = int(np.asarray(jax.device_get(value.step)))
+
+    @property
+    def step(self) -> int:
+        return self._host_step
+
+    # -- sharding layout -----------------------------------------------
+
+    def _table_sharding(self, ndim: int):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # Rows across the WHOLE mesh: maximum HBM capacity, the analogue of
+        # partitioning one table over every PS pod.
+        spec = P((DATA_AXIS, MODEL_AXIS), *([None] * (ndim - 1)))
+        return NamedSharding(self._mesh, spec)
+
+    def _state_shardings(self, state: PSTrainState):
+        repl = shd.replicated(self._mesh)
+        tables = {
+            key: self._table_sharding(np.ndim(value))
+            for key, value in state.tables.items()
+        }
+        slots = {
+            key: {
+                name: self._table_sharding(np.ndim(value))
+                for name, value in group.items()
+            }
+            for key, group in state.slots.items()
+        }
+        return PSTrainState(
+            step=repl,
+            params=jax.tree.map(lambda _: repl, state.params),
+            opt_state=jax.tree.map(lambda _: repl, state.opt_state),
+            model_state=jax.tree.map(lambda _: repl, state.model_state),
+            tables=tables,
+            slots=slots,
+        )
+
+    def _place_state(self, state: PSTrainState) -> PSTrainState:
+        shardings = self._state_shardings(state)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, s)
+            if jax.process_count() == 1
+            else jax.make_array_from_callback(
+                np.shape(x), s, lambda idx, _x=np.asarray(x): _x[idx]
+            ),
+            state,
+            shardings,
+        )
+
+    # -- initialization -------------------------------------------------
+
+    def ensure_initialized(self, features) -> PSTrainState:
+        if self._state is not None:
+            return self._state
+        rng = jax.random.PRNGKey(self._seed)
+        # Init with the GLOBAL batch shape (local rows x process count):
+        # perturbation variables take their shape from init, and apply runs
+        # on the assembled global batch.  Zeros keep init identical on
+        # every rank (param init only consumes shapes + rng).
+        procs = jax.process_count()
+        features = jax.tree.map(
+            lambda x: jnp.zeros(
+                (np.shape(x)[0] * procs,) + tuple(np.shape(x)[1:]),
+                np.asarray(x).dtype,
+            ),
+            features,
+        )
+        variables = dict(self._model.init(rng, features))
+        params_boxed = variables.pop("params")
+        variables.pop(IDS_COLLECTION, None)
+        perturbs = variables.pop(PERTURBATIONS, {})
+        model_state = variables
+
+        # Split tables (VOCAB_AXIS-marked Partitioned leaves) from dense.
+        tables: Dict[str, jnp.ndarray] = {}
+        self._table_paths = {}
+
+        def split(path, leaf):
+            if (
+                isinstance(leaf, nn.Partitioned)
+                and leaf.names
+                and leaf.names[0] == VOCAB_AXIS
+            ):
+                key = _path_key(path)
+                tables[key] = leaf.unbox()
+                self._table_paths[key] = tuple(
+                    getattr(p, "key", p) for p in path
+                )
+                return jnp.zeros((), jnp.float32)  # structure placeholder
+            return leaf.unbox() if isinstance(leaf, nn.Partitioned) else leaf
+
+        flat = jax.tree_util.tree_flatten_with_path(
+            params_boxed,
+            is_leaf=lambda x: isinstance(x, nn.Partitioned),
+        )
+        params = jax.tree_util.tree_unflatten(
+            flat[1], [split(p, v) for p, v in flat[0]]
+        )
+        slots = {
+            key: self._emb_tx.init_slots(table) for key, table in tables.items()
+        }
+        self._perturb_shapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), _unbox(perturbs)
+        )
+        state = PSTrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self._tx.init(params),
+            model_state=_unbox(model_state),
+            tables=tables,
+            slots=slots,
+        )
+        if self._pending_restore is not None:
+            state = self._pending_restore
+            self._pending_restore = None
+        self._state = self._place_state(jax.device_get(state))
+        n_dense = sum(
+            int(np.prod(np.shape(p))) for p in jax.tree.leaves(params)
+        )
+        n_table = sum(int(np.prod(t.shape)) for t in tables.values())
+        logger.info(
+            "Initialized PS-mode model: %d dense params (replicated), "
+            "%d embedding-table params in %d table(s) sharded over %d "
+            "device(s) [%s]",
+            n_dense,
+            n_table,
+            len(tables),
+            self._mesh.devices.size,
+            self._emb_tx.name,
+        )
+        self._compile_steps()
+        return self._state
+
+    def _compile_steps(self):
+        repl = shd.replicated(self._mesh)
+        batch = shd.batch_sharded(self._mesh)
+        state_shardings = self._state_shardings(self._state)
+        self._train_step = jax.jit(
+            self._train_step_impl,
+            in_shardings=(state_shardings, batch, batch, batch),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=(0,),
+        )
+        self._eval_step = jax.jit(
+            self._eval_step_impl,
+            in_shardings=(state_shardings, batch),
+            out_shardings=batch,
+        )
+
+    # -- compiled steps -------------------------------------------------
+
+    def _merge_params(self, params, tables):
+        flat = jax.tree_util.tree_flatten_with_path(params)
+        merged = [
+            tables.get(_path_key(path), leaf) for path, leaf in flat[0]
+        ]
+        return jax.tree_util.tree_unflatten(flat[1], merged)
+
+    def _zero_perturbations(self):
+        return jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._perturb_shapes
+        )
+
+    def _train_step_impl(self, state: PSTrainState, features, labels, mask):
+        mutable_keys = list(state.model_state.keys()) + [IDS_COLLECTION]
+
+        def compute_loss(params, perturbs):
+            full_params = self._merge_params(params, state.tables)
+            variables = {
+                "params": full_params,
+                PERTURBATIONS: perturbs,
+                **state.model_state,
+            }
+            outputs, muts = _model_apply(
+                self._model, variables, features, train=True,
+                mutable=mutable_keys,
+            )
+            losses = self._per_example_loss(labels, outputs)
+            loss = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            return loss, muts
+
+        (loss, muts), (dense_grads, perturb_grads) = jax.value_and_grad(
+            compute_loss, argnums=(0, 1), has_aux=True
+        )(state.params, self._zero_perturbations())
+
+        updates, new_opt_state = self._tx.update(
+            dense_grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+
+        # Sparse apply per table: pair sown ids with perturbation grads.
+        ids_tree = muts.get(IDS_COLLECTION, {})
+        new_tables = dict(state.tables)
+        new_slots = dict(state.slots)
+        for key, module_path in self._table_paths.items():
+            prefix = module_path[:-1]  # drop the 'embedding' param name
+            ids = _collection_get(ids_tree, prefix, "ids")
+            grad = _collection_get(perturb_grads, prefix, "bet")
+            dim = new_tables[key].shape[-1]
+            flat_ids = ids.reshape((-1,))
+            flat_grads = grad.reshape((-1, dim)).astype(new_tables[key].dtype)
+            new_tables[key], new_slots[key] = self._emb_tx.apply(
+                new_tables[key], new_slots[key], flat_ids, flat_grads
+            )
+
+        new_model_state = (
+            {k: muts[k] for k in state.model_state.keys() if k in muts}
+            or state.model_state
+        )
+        return (
+            PSTrainState(
+                state.step + 1,
+                new_params,
+                new_opt_state,
+                new_model_state,
+                new_tables,
+                new_slots,
+            ),
+            loss,
+        )
+
+    def _eval_step_impl(self, state: PSTrainState, features):
+        variables = {
+            "params": self._merge_params(state.params, state.tables),
+            PERTURBATIONS: self._zero_perturbations(),
+            **state.model_state,
+        }
+        outputs, _ = _model_apply(
+            self._model, variables, features, train=False,
+            mutable=[IDS_COLLECTION],
+        )
+        return outputs
+
+    # -- host-side entry points (same shapes contract as DP trainer) ----
+
+    def train_step(self, features, labels):
+        block = self.local_block(
+            jax.tree.leaves(features)[0].shape[0]
+        )
+        features, mask = shd.pad_batch(features, block)
+        labels, _ = shd.pad_batch(labels, block)
+        return self.train_step_local(features, labels, mask)
+
+    def train_step_local(self, features, labels, mask):
+        state = self.ensure_initialized(features)
+        features = shd.assemble_global_batch(features, self._mesh)
+        labels = shd.assemble_global_batch(labels, self._mesh)
+        mask = shd.assemble_global_batch(np.asarray(mask, np.float32), self._mesh)
+        self._state, loss = self._train_step(state, features, labels, mask)
+        self._host_step += 1
+        return loss
+
+    def eval_step(self, features):
+        n = jax.tree.leaves(features)[0].shape[0]
+        block = self.local_block(n)
+        features, _ = shd.pad_batch(features, block)
+        outputs = self.eval_step_local(features)
+        return jax.tree.map(lambda x: np.asarray(x)[:n], outputs)
+
+    def eval_step_local(self, features):
+        state = self.ensure_initialized(features)
+        features = shd.assemble_global_batch(features, self._mesh)
+        outputs = self._eval_step(state, features)
+        return shd.gather_to_host(outputs)
+
+    def state_to_host(self) -> Optional[PSTrainState]:
+        """Host-complete snapshot for checkpointing.  Tables/slots are
+        sharded across processes, so this is a COLLECTIVE (allgather) —
+        every process must call it, even though only rank 0 writes."""
+        if self._state is None:
+            return None
+        state = self._state
+        return PSTrainState(
+            step=jax.device_get(state.step),
+            params=jax.device_get(state.params),
+            opt_state=jax.device_get(state.opt_state),
+            model_state=jax.device_get(state.model_state),
+            tables={k: shd.gather_to_host(v) for k, v in state.tables.items()},
+            slots={
+                k: {n: shd.gather_to_host(v) for n, v in group.items()}
+                for k, group in state.slots.items()
+            },
+        )
+
+    def get_variables_numpy(self) -> dict:
+        if self._state is None:
+            return {}
+        state = self._state
+        flat = {}
+        merged = self._merge_params(
+            jax.device_get(state.params),
+            {k: jax.device_get(v) for k, v in state.tables.items()},
+        )
+        tree = {"params": merged, **jax.device_get(state.model_state)}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            flat[_path_key(path)] = np.asarray(leaf)
+        return flat
+
+
+def _collection_get(tree, module_path: Tuple, name: str):
+    """Fetch collection value at tree[module_path...][name], unwrapping
+    flax's sow tuple."""
+    node = tree
+    for part in module_path:
+        node = node[part]
+    value = node[name]
+    if isinstance(value, tuple):  # sow appends into a tuple
+        value = value[0]
+    return value

@@ -1,0 +1,180 @@
+"""Sparse row-wise optimizers for sharded embedding tables.
+
+Parity: the reference's native optimizer kernels
+(elasticdl/pkg/kernel/capi/kernel_api.cc via elasticdl/pkg/optimizer — the
+Eigen-backed SGD/Adam/Momentum/AdaGrad `*SparseApply` paths the Go PS runs
+on pushed IndexedSlices).  Here the same math is a few scatter/gather ops
+inside the jit-compiled train step: the update touches only the looked-up
+rows, slot variables (accumulators/moments) are tables of the same sharded
+shape, and XLA routes the scattered rows over ICI to whichever chip owns
+them.  elasticdl_tpu/native/kernel_api.cc mirrors these kernels in C++ for
+host-side parity testing (golden values shared by both suites).
+
+Semantics notes (same trade-offs as TF's sparse optimizer application):
+- SGD / AdaGrad apply duplicate ids additively (scatter-add), which equals
+  the exact segment-summed gradient update.
+- Momentum/Adam use gather-update-scatter on the touched rows; duplicate
+  ids within one minibatch collapse to a single slot update computed from
+  their summed gradient (lazy semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SparseOptimizer:
+    """A row-wise optimizer: init_slots(table) -> slots dict;
+    apply(table, slots, ids, grads) -> (new_table, new_slots).
+
+    ids: int32 [n]; grads: [n, dim] (already flattened by the trainer).
+    """
+
+    name: str
+    init_slots: Callable[[jnp.ndarray], Dict[str, jnp.ndarray]]
+    apply: Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+    hyperparams: dict = field(default_factory=dict)
+
+
+def _dedup(ids, grads):
+    """Collapse duplicate ids to segment-summed grads with static shapes
+    (sort + segment_sum, O(n log n)): returns (sorted_ids, summed_grads,
+    is_segment_start).  Each duplicate group's grads are summed at its
+    first sorted position; the rest carry zero gradient, so
+    gather-update-scatter is well-defined under duplicates."""
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    s_ids = ids[order]
+    s_grads = grads[order]
+    starts = jnp.concatenate(
+        [jnp.ones((1,), bool), s_ids[1:] != s_ids[:-1]]
+    )
+    segments = jnp.cumsum(starts) - 1                       # [n]
+    per_segment = jax.ops.segment_sum(s_grads, segments, num_segments=n)
+    summed = per_segment[segments] * starts[:, None].astype(grads.dtype)
+    return s_ids, summed, starts
+
+
+def sgd(learning_rate: float = 0.01) -> SparseOptimizer:
+    lr = learning_rate
+
+    def init_slots(table):
+        return {}
+
+    def apply(table, slots, ids, grads):
+        return table.at[ids].add(-lr * grads), slots
+
+    return SparseOptimizer("sgd", init_slots, apply, {"learning_rate": lr})
+
+
+def momentum(
+    learning_rate: float = 0.01, mu: float = 0.9, nesterov: bool = False
+) -> SparseOptimizer:
+    lr = learning_rate
+
+    def init_slots(table):
+        return {"momentum": jnp.zeros_like(table)}
+
+    def apply(table, slots, ids, grads):
+        ids, grads, is_first = _dedup(ids, grads)
+        # All-zero gradient rows (padding positions, fully-masked batches)
+        # must not decay momentum or move the row.
+        is_first = is_first & jnp.any(grads != 0, axis=-1)
+        v_rows = slots["momentum"][ids]
+        v_new = mu * v_rows + grads
+        # Slot writes must be scatter-ADDs of deltas: scatter-set with
+        # duplicate ids is order-undefined and can let a stale row win.
+        delta_v = jnp.where(is_first[:, None], v_new - v_rows, 0.0)
+        new_momentum = slots["momentum"].at[ids].add(delta_v)
+        step = (mu * v_new + grads) if nesterov else v_new
+        new_table = table.at[ids].add(
+            jnp.where(is_first[:, None], -lr * step, 0.0)
+        )
+        return new_table, {"momentum": new_momentum}
+
+    return SparseOptimizer(
+        "momentum", init_slots, apply,
+        {"learning_rate": lr, "momentum": mu, "nesterov": nesterov},
+    )
+
+
+def adagrad(learning_rate: float = 0.01, epsilon: float = 1e-7) -> SparseOptimizer:
+    lr = learning_rate
+
+    def init_slots(table):
+        return {"accumulator": jnp.zeros_like(table)}
+
+    def apply(table, slots, ids, grads):
+        ids, grads, is_first = _dedup(ids, grads)
+        acc = slots["accumulator"].at[ids].add(grads * grads)
+        rows = acc[ids]
+        update = -lr * grads / (jnp.sqrt(rows) + epsilon)
+        new_table = table.at[ids].add(jnp.where(is_first[:, None], update, 0.0))
+        return new_table, {"accumulator": acc}
+
+    return SparseOptimizer(
+        "adagrad", init_slots, apply,
+        {"learning_rate": lr, "epsilon": epsilon},
+    )
+
+
+def adam(
+    learning_rate: float = 0.001,
+    beta_1: float = 0.9,
+    beta_2: float = 0.999,
+    epsilon: float = 1e-8,
+) -> SparseOptimizer:
+    lr = learning_rate
+
+    def init_slots(table):
+        return {
+            "m": jnp.zeros_like(table),
+            "v": jnp.zeros_like(table),
+            # Per-row step count for bias correction (the reference's Go
+            # Adam keeps a global step; per-row matches lazy semantics).
+            "t": jnp.zeros((table.shape[0],), jnp.int32),
+        }
+
+    def apply(table, slots, ids, grads):
+        ids, grads, is_first = _dedup(ids, grads)
+        # Zero-grad rows (padding / masked batches) must not decay moments
+        # or advance the per-row step count.
+        is_first = is_first & jnp.any(grads != 0, axis=-1)
+        t = slots["t"].at[ids].add(is_first.astype(jnp.int32))
+        t_rows = jnp.maximum(t[ids], 1).astype(table.dtype)
+        m_rows = slots["m"][ids]
+        v_rows = slots["v"][ids]
+        m_new = beta_1 * m_rows + (1 - beta_1) * grads
+        v_new = beta_2 * v_rows + (1 - beta_2) * grads * grads
+        # Scatter-ADD deltas (duplicate-safe), zero for non-first rows.
+        new_m = slots["m"].at[ids].add(
+            jnp.where(is_first[:, None], m_new - m_rows, 0.0)
+        )
+        new_v = slots["v"].at[ids].add(
+            jnp.where(is_first[:, None], v_new - v_rows, 0.0)
+        )
+        m_hat = m_new / (1 - beta_1 ** t_rows[:, None])
+        v_hat = v_new / (1 - beta_2 ** t_rows[:, None])
+        update = -lr * m_hat / (jnp.sqrt(v_hat) + epsilon)
+        new_table = table.at[ids].add(jnp.where(is_first[:, None], update, 0.0))
+        return new_table, {"m": new_m, "v": new_v, "t": t}
+
+    return SparseOptimizer(
+        "adam", init_slots, apply,
+        {"learning_rate": lr, "beta_1": beta_1, "beta_2": beta_2,
+         "epsilon": epsilon},
+    )
+
+
+_BY_NAME = {"sgd": sgd, "momentum": momentum, "adagrad": adagrad, "adam": adam}
+
+
+def by_name(name: str, **hyperparams) -> SparseOptimizer:
+    if name not in _BY_NAME:
+        raise ValueError(f"Unknown sparse optimizer {name!r}; have {sorted(_BY_NAME)}")
+    return _BY_NAME[name](**hyperparams)

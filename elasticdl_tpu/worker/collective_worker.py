@@ -272,11 +272,13 @@ class CollectiveWorker:
             self._last_reported_version = step
 
     def _maybe_checkpoint(self, force: bool = False):
-        """Every rank computes the save decision identically; only rank 0
-        writes (state is replicated, so its copy is complete)."""
+        """Every rank computes the save decision identically and joins the
+        host-gather (a collective for sharded tables); only rank 0 writes."""
         if self._ckpt is None or self._trainer.state is None:
             return
         step = self._trainer.step
         due = force or (self._ckpt_steps and step % self._ckpt_steps == 0)
-        if due and self._world.is_leader and step > 0:
-            self._ckpt.save(self._trainer.state, step)
+        if due and step > 0:
+            host_state = self._trainer.state_to_host()
+            if self._world.is_leader:
+                self._ckpt.save(host_state, step)

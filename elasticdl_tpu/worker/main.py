@@ -77,12 +77,27 @@ def _build_collective_worker(
 
     world = join_world(client)
     mesh = build_mesh(MeshConfig())  # all devices of the joined world
-    trainer = DataParallelTrainer(
-        model=model_spec.build_model(),
-        loss_fn=model_spec.loss,
-        optimizer=model_spec.optimizer(),
-        mesh=mesh,
-    )
+    if args.distribution_strategy == "ParameterServerStrategy":
+        from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+
+        trainer = ShardedEmbeddingTrainer(
+            model=model_spec.build_model(),
+            loss_fn=model_spec.loss,
+            optimizer=model_spec.optimizer(),
+            mesh=mesh,
+            embedding_optimizer=(
+                model_spec.embedding_optimizer()
+                if model_spec.embedding_optimizer is not None
+                else None
+            ),
+        )
+    else:
+        trainer = DataParallelTrainer(
+            model=model_spec.build_model(),
+            loss_fn=model_spec.loss,
+            optimizer=model_spec.optimizer(),
+            mesh=mesh,
+        )
     saver = (
         CheckpointSaver(args.checkpoint_dir, keep_max=args.keep_checkpoint_max)
         if args.checkpoint_dir

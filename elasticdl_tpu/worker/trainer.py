@@ -32,6 +32,18 @@ class TrainState(NamedTuple):
     model_state: Any  # non-trainable collections, e.g. batch_stats
 
 
+def _unbox_partitioned(tree):
+    """Strip flax partitioning metadata boxes (sharding hints are consumed
+    by the sharded trainers; the dense trainers want plain arrays)."""
+    import flax.linen as nn
+
+    return jax.tree.map(
+        lambda x: x.unbox() if isinstance(x, nn.Partitioned) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )
+
+
 def _model_apply(model, variables, features, train: bool, mutable):
     """Call a flax module, passing `train` only if the model accepts it."""
     call_params = inspect.signature(model.__call__).parameters
@@ -73,10 +85,10 @@ class Trainer:
 
     def _init_state(self, features) -> TrainState:
         rng = jax.random.PRNGKey(self._seed)
-        variables = self._model.init(rng, jnp.asarray(features))
+        variables = self._model.init(rng, jax.tree.map(jnp.asarray, features))
         variables = dict(variables)
-        params = variables.pop("params")
-        model_state = variables  # batch_stats etc (may be empty)
+        params = _unbox_partitioned(variables.pop("params"))
+        model_state = _unbox_partitioned(variables)  # batch_stats etc
         opt_state = self._tx.init(params)
         logger.info(
             "Initialized model: %d parameters",

@@ -12,7 +12,7 @@ import urllib.parse
 
 import numpy as np
 
-from elasticdl_tpu.data.reader import NumpyDataReader
+from elasticdl_tpu.data.reader import AbstractDataReader, NumpyDataReader
 
 
 def parse_synthetic_path(data_path: str):
@@ -56,6 +56,48 @@ def synthetic_cifar10_reader(n: int = 4096, seed: int = 0, shard_name="cifar-syn
         mask = labels == cls
         images[mask, rows : rows + 8, cols : cols + 6, channel] = 220
     return NumpyDataReader(images, labels, shard_name=shard_name)
+
+
+def synthetic_ctr_reader(
+    n: int = 4096,
+    num_dense: int = 13,
+    num_categorical: int = 26,
+    vocab_size: int = 1000,
+    seed: int = 0,
+    shard_name: str = "ctr-synth",
+):
+    """Criteo/census-shaped learnable CTR data.
+
+    A record is ({'dense': float32[num_dense], 'cat': int32[num_categorical]},
+    label in {0,1}).  The label depends on a sparse set of (field, id)
+    weights plus a linear term on the dense features, so both the embedding
+    path and the dense path must learn for accuracy to move.
+    """
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal((n, num_dense)).astype(np.float32)
+    cats = rng.integers(0, vocab_size, size=(n, num_categorical)).astype(np.int32)
+    field_weights = rng.standard_normal((num_categorical, vocab_size)).astype(
+        np.float32
+    )
+    dense_weights = rng.standard_normal((num_dense,)).astype(np.float32)
+    cat_logit = np.zeros((n,), np.float32)
+    for field in range(num_categorical):
+        cat_logit += field_weights[field, cats[:, field]]
+    logits = dense @ dense_weights + cat_logit / np.sqrt(num_categorical)
+    labels = (logits > np.median(logits)).astype(np.int32)
+    records = [
+        ({"dense": dense[i], "cat": cats[i]}, labels[i]) for i in range(n)
+    ]
+
+    class _CTRReader(AbstractDataReader):
+        def create_shards(self):
+            return {shard_name: len(records)}
+
+        def read_records(self, task):
+            for i in range(task.start, min(task.end, len(records))):
+                yield records[i]
+
+    return _CTRReader()
 
 
 def synthetic_classification_reader(
