@@ -1,0 +1,166 @@
+"""Native (C++) host kernel library: build + ctypes bindings.
+
+Parity: the reference's native layer (elasticdl/pkg/kernel — cgo bindings
+over Eigen C++ kernels).  The build is a single translation unit compiled
+to a shared library; bindings are ctypes (the environment ships no
+pybind11), with numpy arrays passed as raw pointers.
+
+`load()` returns the bound library, building it on first use when a C++
+toolchain is present; callers treat None as "native unavailable" and fall
+back to the pure-Python/JAX paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_DIR, "libedl_kernels.so")
+_SOURCE = os.path.join(_DIR, "kernel_api.cc")
+_lib = None
+_load_failed = False
+
+
+def build_native(force: bool = False) -> Optional[str]:
+    """Compile kernel_api.cc -> libedl_kernels.so. Returns the path, or
+    None when no toolchain / compile failure."""
+    if os.path.exists(_SO_PATH) and not force:
+        if os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SOURCE):
+            return _SO_PATH
+    for compiler in ("g++", "c++", "clang++"):
+        try:
+            subprocess.run(
+                [compiler, "-O3", "-shared", "-fPIC", "-std=c++17",
+                 _SOURCE, "-o", _SO_PATH],
+                check=True, capture_output=True, timeout=120,
+            )
+            logger.info("Built native kernels with %s -> %s", compiler, _SO_PATH)
+            return _SO_PATH
+        except FileNotFoundError:
+            continue
+        except subprocess.CalledProcessError as exc:
+            logger.error(
+                "Native kernel build failed (%s): %s",
+                compiler, exc.stderr.decode()[:2000],
+            )
+            return None
+    logger.warning("No C++ compiler found; native kernels unavailable")
+    return None
+
+
+def _bind(lib):
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i64 = ctypes.c_int64
+    f32 = ctypes.c_float
+    i32 = ctypes.c_int
+    lib.edl_sgd_dense.argtypes = [f32p, f32p, f32, i64]
+    lib.edl_momentum_dense.argtypes = [f32p, f32p, f32p, f32, f32, i32, i64]
+    lib.edl_adagrad_dense.argtypes = [f32p, f32p, f32p, f32, f32, i64]
+    lib.edl_adam_dense.argtypes = [f32p, f32p, f32p, f32p, f32, f32, f32, f32,
+                                   i64, i64]
+    lib.edl_sgd_sparse.argtypes = [f32p, i64, i64p, f32p, i64, f32]
+    lib.edl_momentum_sparse.argtypes = [f32p, f32p, i64, i64p, f32p, i64, f32,
+                                        f32, i32]
+    lib.edl_adagrad_sparse.argtypes = [f32p, f32p, i64, i64p, f32p, i64, f32,
+                                       f32]
+    lib.edl_adam_sparse.argtypes = [f32p, f32p, f32p, i64p, i64, i64p, f32p,
+                                    i64, f32, f32, f32, f32]
+    return lib
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    path = build_native()
+    if path is None:
+        _load_failed = True
+        return None
+    _lib = _bind(ctypes.CDLL(path))
+    return _lib
+
+
+def _fp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _ip(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _check(a, dtype):
+    a = np.ascontiguousarray(a, dtype)
+    return a
+
+
+class NativeKernels:
+    """Numpy-facing wrapper over the C bindings (in-place updates)."""
+
+    def __init__(self):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native kernels unavailable (no C++ toolchain)")
+
+    # Dense -------------------------------------------------------------
+
+    def sgd(self, param, grad, lr):
+        self._lib.edl_sgd_dense(_fp(param), _fp(grad), lr, param.size)
+
+    def momentum(self, param, velocity, grad, lr, mu, nesterov=False):
+        self._lib.edl_momentum_dense(
+            _fp(param), _fp(velocity), _fp(grad), lr, mu, int(nesterov),
+            param.size,
+        )
+
+    def adagrad(self, param, accum, grad, lr, eps=1e-7):
+        self._lib.edl_adagrad_dense(
+            _fp(param), _fp(accum), _fp(grad), lr, eps, param.size
+        )
+
+    def adam(self, param, m, v, grad, lr, beta1, beta2, eps, step):
+        self._lib.edl_adam_dense(
+            _fp(param), _fp(m), _fp(v), _fp(grad), lr, beta1, beta2, eps,
+            step, param.size,
+        )
+
+    # Sparse ------------------------------------------------------------
+
+    def sgd_sparse(self, table, ids, grads, lr):
+        ids = _check(ids, np.int64)
+        self._lib.edl_sgd_sparse(
+            _fp(table), table.shape[1], _ip(ids), _fp(grads), len(ids), lr
+        )
+
+    def momentum_sparse(self, table, velocity, ids, grads, lr, mu,
+                        nesterov=False):
+        ids = _check(ids, np.int64)
+        self._lib.edl_momentum_sparse(
+            _fp(table), _fp(velocity), table.shape[1], _ip(ids), _fp(grads),
+            len(ids), lr, mu, int(nesterov),
+        )
+
+    def adagrad_sparse(self, table, accum, ids, grads, lr, eps=1e-7):
+        ids = _check(ids, np.int64)
+        self._lib.edl_adagrad_sparse(
+            _fp(table), _fp(accum), table.shape[1], _ip(ids), _fp(grads),
+            len(ids), lr, eps,
+        )
+
+    def adam_sparse(self, table, m, v, t_rows, ids, grads, lr,
+                    beta1=0.9, beta2=0.999, eps=1e-8):
+        ids = _check(ids, np.int64)
+        self._lib.edl_adam_sparse(
+            _fp(table), _fp(m), _fp(v), _ip(t_rows), table.shape[1],
+            _ip(ids), _fp(grads), len(ids), lr, beta1, beta2, eps,
+        )
