@@ -45,6 +45,20 @@ IDS_COLLECTION = "embedding_ids"
 PERTURBATIONS = "perturbations"
 
 
+def strip_capture_collections(variables: dict) -> dict:
+    """Drop the sparse-grad capture collections from an init-variables dict.
+
+    Only the PS trainer consumes them; in the dense trainers they would
+    (a) freeze the init batch's shape into model_state (crash on ragged
+    batches) and (b) grow the sow tuple every step (recompile per step).
+    With the collections absent, perturb/sow are no-ops and the table
+    trains by ordinary dense autodiff.
+    """
+    variables.pop(PERTURBATIONS, None)
+    variables.pop(IDS_COLLECTION, None)
+    return variables
+
+
 def default_embedding_init(key, shape, dtype=jnp.float32):
     # Matches the reference's default 'uniform' Keras initializer scale.
     return jax.random.uniform(key, shape, dtype, -0.05, 0.05)

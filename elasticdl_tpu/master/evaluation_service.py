@@ -77,7 +77,9 @@ class EvaluationService:
             tensor.name or "output": tensor_utils.pb_to_ndarray(tensor)
             for tensor in model_outputs_pb
         }
-        labels = tensor_utils.pb_to_ndarray(labels_pb)
+        labels = {
+            tensor.name: tensor_utils.pb_to_ndarray(tensor) for tensor in labels_pb
+        }
         with self._lock:
             if model_version in self._finalized_versions:
                 logger.info(
@@ -120,19 +122,32 @@ class EvaluationService:
         outputs = {
             name: np.concatenate([b[0][name] for b in batches]) for name in output_names
         }
-        labels = np.concatenate([b[1] for b in batches])
+        label_names = batches[0][1].keys()
+        labels = {
+            name: np.concatenate([b[1][name] for b in batches]) for name in label_names
+        }
         metric_fns = self._eval_metrics_fn()
-        main_output = (
-            outputs["output"] if "output" in outputs else next(iter(outputs.values()))
-        )
+        # Contract (reference §3.5): metric fns see ALL named outputs/labels.
+        # The common single-output/single-label case unwraps to bare arrays so
+        # simple `fn(outputs, labels)` metrics keep working.
+        if not outputs or not labels:
+            logger.warning(
+                "Eval round %d reported without %s; dropping round",
+                model_version,
+                "outputs" if not outputs else "labels",
+            )
+            return {}
+        out_arg = outputs if len(outputs) > 1 else next(iter(outputs.values()))
+        lab_arg = labels if len(labels) > 1 else next(iter(labels.values()))
+        n_examples = len(next(iter(labels.values())))
         metrics = {
-            name: float(np.asarray(fn(main_output, labels)))
+            name: float(np.asarray(fn(out_arg, lab_arg)))
             for name, fn in metric_fns.items()
         }
         logger.info(
             "Eval metrics at version %d (%d examples): %s",
             model_version,
-            len(labels),
+            n_examples,
             {k: round(v, 5) for k, v in metrics.items()},
         )
         if self._tensorboard_service is not None:

@@ -67,7 +67,9 @@ class MasterServicer(_Base):
     def report_evaluation_metrics(self, request, context):
         if self._evaluation_service is not None:
             self._evaluation_service.report_evaluation_metrics(
-                request.model_version, list(request.model_outputs), request.labels
+                request.model_version,
+                list(request.model_outputs),
+                list(request.labels),
             )
         return pb.ReportEvaluationMetricsResponse()
 

@@ -5,11 +5,13 @@ collective_ops/communicator.py — SURVEY.md §2.1): `allreduce/broadcast/
 barrier` return SUCCEEDED/FAILED instead of raising, so the training loop
 can react (retry, trigger communicator re-formation) rather than crash.
 
-TPU-native: the data-plane collective is a jitted XLA op over the current
-mesh; what can *fail* is the distributed runtime when a peer process dies
-mid-collective.  We catch that and surface FAILED — the elastic layer
-(parallel/elastic.py) then re-forms the mesh over survivors, exactly where
-the reference re-forms its NCCL ring.
+TPU-native: per-step gradient reduction is a compiled psum inside the
+train step, NOT this class.  This is the *control-plane* collective —
+host-side reductions over the process set (metric sync, param averaging on
+re-formation) via jax.distributed/multihost_utils.  What can *fail* is the
+distributed runtime when a peer process dies mid-collective; we catch that
+and surface FAILED — the elastic layer (parallel/elastic.py) then re-forms
+the mesh over survivors, exactly where the reference re-forms its NCCL ring.
 """
 
 from __future__ import annotations
@@ -20,7 +22,6 @@ from typing import Any, Optional
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.parallel import sharding as shd
 
 logger = get_logger("parallel.collective")
 
@@ -38,56 +39,38 @@ class CollectiveCommunicator:
     """
 
     def __init__(self, mesh):
-        self._mesh = mesh
-        self._jit_cache: dict = {}
-
-    @property
-    def mesh(self):
-        return self._mesh
-
-    def _jitted(self, name, fn, in_shardings, out_shardings):
-        import jax
-
-        key = name
-        if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(
-                fn, in_shardings=in_shardings, out_shardings=out_shardings
-            )
-        return self._jit_cache[key]
+        self._mesh = mesh  # kept for re-formation wiring (elastic layer)
 
     # ------------------------------------------------------------------
 
     def allreduce(self, data: Any, op: str = "MEAN"):
-        """Mean/sum of a host array over the mesh's device set.
+        """Mean/sum of a host array contributed ONCE per process.
 
-        Returns (CollectiveResult, result_or_None).  Data is replicated in;
-        with every participant contributing via their sharded batch the
-        reduction happens inside the train step — this entry point is the
-        *control-plane* collective (metric sync, param averaging on
-        re-formation), mirroring the reference's usage.
+        Returns (CollectiveResult, result_or_None).  Matches the reference's
+        CollectiveCommunicator semantics: each worker process contributes a
+        single value, regardless of how many local devices it drives — this
+        is the *control-plane* collective (metric sync, param averaging on
+        re-formation), not the per-step gradient psum (which lives inside
+        the compiled train step).
         """
         import jax
-        import jax.numpy as jnp
 
+        if op not in ("MEAN", "SUM"):
+            # Programming error, not a peer failure: raise, don't FAIL.
+            raise ValueError(f"Unknown allreduce op {op!r}")
         try:
-            repl = shd.replicated(self._mesh)
-            batch = shd.batch_sharded(self._mesh)
-            n = shd.data_axis_size(self._mesh)
+            arr = np.asarray(data)
+            if jax.process_count() == 1:
+                stacked = arr[None]
+            else:
+                from jax.experimental import multihost_utils
 
-            def reduce_fn(x):  # x: (n, ...) sharded over data
-                s = jnp.sum(x, axis=0)
-                return s / n if op == "MEAN" else s
-
-            fn = self._jitted(f"allreduce_{op}", reduce_fn, (batch,), repl)
-            # Each process contributes copies for its local devices only
-            # (a host-global device_put cannot target non-addressable
-            # devices in a multi-process mesh).
-            local_rows = max(1, n // jax.process_count())
-            local = np.broadcast_to(
-                np.asarray(data)[None], (local_rows,) + np.asarray(data).shape
-            )
-            tiled = shd.assemble_global_batch(np.ascontiguousarray(local), self._mesh)
-            return CollectiveResult.SUCCEEDED, np.asarray(fn(tiled))
+                stacked = np.asarray(multihost_utils.process_allgather(arr))
+                stacked = stacked.reshape((jax.process_count(),) + arr.shape)
+            total = stacked.sum(axis=0)
+            if op == "MEAN":
+                total = total / stacked.shape[0]
+            return CollectiveResult.SUCCEEDED, total
         except Exception as exc:  # runtime/peer failure → status, not crash
             logger.error("allreduce failed: %s", exc)
             return CollectiveResult.FAILED, None

@@ -115,11 +115,12 @@ class DataParallelTrainer:
 
     def ensure_initialized(self, features) -> TrainState:
         if self._state is None:
+            from elasticdl_tpu.layers.embedding import strip_capture_collections
             from elasticdl_tpu.worker.trainer import _unbox_partitioned
 
             rng = jax.random.PRNGKey(self._seed)
-            variables = dict(
-                self._model.init(rng, jax.tree.map(jnp.asarray, features))
+            variables = strip_capture_collections(
+                dict(self._model.init(rng, jax.tree.map(jnp.asarray, features)))
             )
             variables = _unbox_partitioned(variables)
             params = variables.pop("params")

@@ -31,6 +31,7 @@ from elasticdl_tpu.parallel import sharding as shd
 from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
 from elasticdl_tpu.parallel.elastic import WorldInfo
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.worker import concat_named, named_arrays
 
 logger = get_logger("worker.collective_worker")
 
@@ -244,13 +245,20 @@ class CollectiveWorker:
                     for r, count in enumerate(counts)
                 ]
             ).astype(np.int64)
-            outputs_list.append(np.asarray(outputs)[keep])
-            labels_list.append(np.asarray(global_labels)[keep])
+            outputs_list.append(
+                {
+                    name: arr[keep]
+                    for name, arr in named_arrays(outputs, "output").items()
+                }
+            )
+            labels_list.append(
+                {name: arr[keep] for name, arr in named_arrays(global_labels, "").items()}
+            )
         if outputs_list and report and self._world.is_leader:
             self._mc.report_evaluation_metrics(
                 model_version=task.model_version,
-                model_outputs={"output": np.concatenate(outputs_list)},
-                labels=np.concatenate(labels_list),
+                model_outputs=concat_named(outputs_list),
+                labels=concat_named(labels_list),
             )
         return {TaskExecCounterKey.BATCH_COUNT: batch_count}
 

@@ -41,12 +41,19 @@ class MasterClient:
         self._stub.report_task_result(request)
 
     def report_evaluation_metrics(self, model_version: int, model_outputs, labels):
+        """`model_outputs` is {name: array}; `labels` is an array or a
+        {name: array} dict (multi-label models)."""
         request = pb.ReportEvaluationMetricsRequest(
             worker_id=self._worker_id, model_version=model_version
         )
         for name, array in model_outputs.items():
             request.model_outputs.append(tensor_utils.ndarray_to_pb(array, name=name))
-        request.labels.CopyFrom(tensor_utils.ndarray_to_pb(np.asarray(labels)))
+        if not isinstance(labels, dict):
+            labels = {"": np.asarray(labels)}
+        for name, array in labels.items():
+            request.labels.append(
+                tensor_utils.ndarray_to_pb(np.asarray(array), name=name)
+            )
         self._stub.report_evaluation_metrics(request)
 
     def report_version(self, model_version: int):

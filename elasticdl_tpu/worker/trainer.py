@@ -84,9 +84,11 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _init_state(self, features) -> TrainState:
+        from elasticdl_tpu.layers.embedding import strip_capture_collections
+
         rng = jax.random.PRNGKey(self._seed)
         variables = self._model.init(rng, jax.tree.map(jnp.asarray, features))
-        variables = dict(variables)
+        variables = strip_capture_collections(dict(variables))
         params = _unbox_partitioned(variables.pop("params"))
         model_state = _unbox_partitioned(variables)  # batch_stats etc
         opt_state = self._tx.init(params)

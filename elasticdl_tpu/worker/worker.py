@@ -155,16 +155,16 @@ class Worker:
         batch_count = 0
         for features, labels in dataset:
             outputs = self._trainer.eval_step(features)
-            outputs_list.append(np.asarray(outputs))
-            labels_list.append(np.asarray(labels))
+            outputs_list.append(named_arrays(outputs, "output"))
+            labels_list.append(named_arrays(labels, ""))
             batch_count += 1
         if outputs_list:
             # Report under the round's version so the master aggregates all
             # of a round's tasks together regardless of worker step skew.
             self._mc.report_evaluation_metrics(
                 model_version=task.model_version,
-                model_outputs={"output": np.concatenate(outputs_list)},
-                labels=np.concatenate(labels_list),
+                model_outputs=concat_named(outputs_list),
+                labels=concat_named(labels_list),
             )
         return {TaskExecCounterKey.BATCH_COUNT: batch_count}
 
@@ -188,6 +188,31 @@ class Worker:
         if force or step > self._last_reported_version:
             self._mc.report_version(step)
             self._last_reported_version = step
+
+
+def named_arrays(tree, default_name: str = "output") -> dict:
+    """Flatten a model-output/label pytree into {name: np.ndarray}.
+
+    Dicts (the multi-output contract) keep their keys, nesting joined with
+    '/'; a bare tensor maps to `default_name`.  The reference aggregates
+    arbitrary named outputs/labels through Keras metrics (SURVEY.md §3.5).
+    """
+    if isinstance(tree, dict):
+        flat = {}
+        for key, value in tree.items():
+            if isinstance(value, dict):
+                for sub, arr in named_arrays(value, default_name).items():
+                    flat[f"{key}/{sub}"] = arr
+            else:
+                flat[str(key)] = np.asarray(value)
+        return flat
+    return {default_name: np.asarray(tree)}
+
+
+def concat_named(batches: list) -> dict:
+    """Concatenate a list of {name: array} dicts along axis 0."""
+    names = batches[0].keys()
+    return {name: np.concatenate([b[name] for b in batches]) for name in names}
 
 
 def _batch_size_of(features) -> int:

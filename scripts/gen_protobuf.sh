@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Regenerate the checked-in protobuf module from elasticdl.proto.
+# Parity: the reference's scripts/gen_protobuf.sh (protoc for py + go);
+# here only the Python codec is needed (gRPC servicer/stub glue is
+# hand-written in elasticdl_tpu/proto/service.py to avoid a grpcio-tools
+# build dependency).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+protoc --proto_path=elasticdl_tpu/proto \
+       --python_out=elasticdl_tpu/proto \
+       elasticdl_tpu/proto/elasticdl.proto
+echo "regenerated elasticdl_tpu/proto/elasticdl_pb2.py"

@@ -268,6 +268,36 @@ def test_embedding_trains_densely_under_local_trainer():
     assert np.abs(after - before).max() > 0, "embedding table never trained"
 
 
+def test_dense_trainer_handles_ragged_batches():
+    """The capture collections (perturbations/ids) must NOT live in
+    model_state: they'd freeze the init batch's shape (crash on a ragged
+    final batch) and grow the sow tuple every step (recompile per step)."""
+    trainer = Trainer(SparseModel(), _loss, optax.sgd(0.2), seed=0)
+    rng = np.random.RandomState(0)
+    for batch in (16, 16, 7, 16, 3):  # ragged sizes interleaved
+        ids = rng.randint(0, VOCAB, size=(batch, 3)).astype(np.int32)
+        labels = rng.randint(0, 4, size=batch).astype(np.int32)
+        trainer.train_step(ids, labels)
+    state = trainer.state
+    assert "perturbations" not in state.model_state
+    assert "embedding_ids" not in state.model_state
+
+
+def test_dp_trainer_handles_ragged_batches():
+    """Same invariant for the AllReduce trainer (padded final batch)."""
+    from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+
+    mesh = build_mesh(MeshConfig())
+    trainer = DataParallelTrainer(SparseModel(), _loss, optax.sgd(0.2), mesh)
+    rng = np.random.RandomState(0)
+    for batch in (16, 5):
+        ids = rng.randint(0, VOCAB, size=(batch, 3)).astype(np.int32)
+        labels = rng.randint(0, 4, size=batch).astype(np.int32)
+        trainer.train_step(ids, labels)
+    assert "perturbations" not in trainer.state.model_state
+    assert "embedding_ids" not in trainer.state.model_state
+
+
 def test_masked_batch_does_not_touch_adam_slots():
     """A fully-masked (all-zero-grad) step must leave tables and moments
     untouched (padding rows must not drift)."""

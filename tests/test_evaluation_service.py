@@ -23,7 +23,7 @@ def report(service, version, outputs, labels):
     service.report_evaluation_metrics(
         version,
         [tensor_utils.ndarray_to_pb(np.asarray(outputs), name="output")],
-        tensor_utils.ndarray_to_pb(np.asarray(labels)),
+        [tensor_utils.ndarray_to_pb(np.asarray(labels))],
     )
 
 

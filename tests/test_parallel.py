@@ -101,9 +101,11 @@ def test_collective_allreduce_and_barrier():
     status, out = comm.allreduce(np.array([2.0, 4.0]), op="MEAN")
     assert status == CollectiveResult.SUCCEEDED
     np.testing.assert_allclose(out, [2.0, 4.0])
+    # SUM contributes once per PROCESS, not per device (reference
+    # CollectiveCommunicator semantics): 1 process here, so sum == input.
     status, out = comm.allreduce(np.array([1.0]), op="SUM")
     assert status == CollectiveResult.SUCCEEDED
-    np.testing.assert_allclose(out, [8.0])  # 8 participants
+    np.testing.assert_allclose(out, [1.0])
     assert comm.barrier() == CollectiveResult.SUCCEEDED
     status, same = comm.broadcast(np.array([3.0]))
     assert status == CollectiveResult.SUCCEEDED
