@@ -9,24 +9,32 @@ kernels.
 
 TPU-native translation of each piece:
 
-- PS-partitioned table            -> one flax param per layer, marked
-  `nn.with_partitioning` on the VOCAB_AXIS; the trainer maps that logical
-  axis across the WHOLE mesh, so a table's rows spread over every chip's
-  HBM (the capacity story of the PS, without the gRPC hop).
-- pull_embedding_vectors          -> a gather on the sharded table inside
-  the jit step; XLA lowers it to on-chip gathers + ICI collectives.
+- PS-partitioned table            -> one flax param per layer in PACKED
+  lane-tiled storage (parallel/packed.py: [vocab/R, 128] so lookups and
+  scatter-updates move full 512-byte lanes — a logical [vocab, dim] array
+  with narrow dim is hostile to TPU tiling either way it's laid out),
+  marked `nn.with_partitioning` on the VOCAB_AXIS; the trainer maps that
+  logical axis across the WHOLE mesh, so a table's storage blocks spread
+  over every chip's HBM (the capacity story of the PS, without the gRPC
+  hop).
+- pull_embedding_vectors          -> packed gather + one-hot slot-select
+  einsum inside the jit step; XLA lowers it to on-chip gathers + ICI
+  collectives.
 - tape.watch(bet) + IndexedSlices -> `self.perturb(...)`: a zeros variable
   added to the looked-up activations.  Autodiff gives the activation
   gradient at that point WITHOUT differentiating through the (huge) table
-  — the lookup itself is wrapped in stop_gradient, so no dense
-  [vocab, dim] cotangent ever exists.
-- push_gradients (sparse apply)   -> the trainer scatter-applies
-  (ids, activation-grads) with the sparse row-wise optimizers in
-  elasticdl_tpu/parallel/sparse_optim.py (the Eigen kernel parity surface).
+  — under the PS trainer the table is a closure constant of the loss, so
+  no dense [vocab, dim] cotangent ever exists.
+- push_gradients (sparse apply)   -> the trainer applies (ids,
+  activation-grads) with the streaming packed row-wise optimizers in
+  elasticdl_tpu/parallel/sparse_optim.py (the Eigen kernel parity
+  surface).
 
 The layer `sow`s its ids each call so the trainer can pair them with the
-perturbation gradients.  One `__call__` per layer instance per step (same
-restriction as the reference layer).
+perturbation gradients, and records its (vocab, dim) spec in the
+SPECS_COLLECTION so the trainer can address the packed storage.  One
+`__call__` per layer instance per step (same restriction as the reference
+layer).
 """
 
 from __future__ import annotations
@@ -37,12 +45,44 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-# Logical axis name for table rows; the PS/sharded trainer maps it to the
-# physical mesh (all axes), everything else replicates.
+from elasticdl_tpu.parallel import packed as pk
+from elasticdl_tpu.parallel.packed import PackedSpec
+
+# Logical axis name for table storage blocks; the PS/sharded trainer maps
+# it to the physical mesh (all axes), everything else replicates.
 VOCAB_AXIS = "embedding_vocab"
-# Variable collections used to smuggle ids/activation-grads per step.
+# Variable collections used to smuggle ids/activation-grads/table-specs
+# per step.
 IDS_COLLECTION = "embedding_ids"
 PERTURBATIONS = "perturbations"
+SPECS_COLLECTION = "embedding_specs"
+
+
+def export_spec_map(variables: dict) -> dict:
+    """{'params/<module path>/embedding': PackedSpec} from an
+    init-variables dict's SPECS_COLLECTION — lets exporters unpack packed
+    table params back to their logical [vocab, dim] view.  Call BEFORE
+    strip_capture_collections."""
+    import numpy as np
+
+    out = {}
+
+    def walk(node, path):
+        if not isinstance(node, dict):
+            return
+        if "spec" in node and not isinstance(node["spec"], dict):
+            value = node["spec"]
+            if isinstance(value, tuple):  # sow wraps in a tuple
+                value = value[0]
+            arr = np.asarray(value)
+            key = "/".join(("params",) + path + ("embedding",))
+            out[key] = PackedSpec(int(arr[0]), int(arr[1]))
+            return
+        for name, child in node.items():
+            walk(child, path + (name,))
+
+    walk(variables.get(SPECS_COLLECTION, {}), ())
+    return out
 
 
 def strip_capture_collections(variables: dict) -> dict:
@@ -56,6 +96,7 @@ def strip_capture_collections(variables: dict) -> dict:
     """
     variables.pop(PERTURBATIONS, None)
     variables.pop(IDS_COLLECTION, None)
+    variables.pop(SPECS_COLLECTION, None)
     return variables
 
 
@@ -65,7 +106,7 @@ def default_embedding_init(key, shape, dtype=jnp.float32):
 
 
 class Embedding(nn.Module):
-    """Vocab-sharded embedding lookup with sparse-gradient capture.
+    """Vocab-sharded packed embedding lookup with sparse-gradient capture.
 
     ids: int array [batch] or [batch, length]; negative ids are treated as
     padding (contribute zeros, receive no gradient).
@@ -79,26 +120,42 @@ class Embedding(nn.Module):
     dtype: jnp.dtype = jnp.float32
     embeddings_initializer: Callable = default_embedding_init
 
+    @property
+    def spec(self) -> PackedSpec:
+        return PackedSpec(self.vocab_size, self.embedding_dim)
+
     @nn.compact
     def __call__(self, ids):
+        spec = self.spec
         table = self.param(
             "embedding",
             nn.with_partitioning(
-                self.embeddings_initializer, (VOCAB_AXIS, None)
+                pk.packed_init(spec, self.embeddings_initializer),
+                (VOCAB_AXIS, None),
             ),
-            (self.vocab_size, self.embedding_dim),
+            spec.packed_shape,
             self.dtype,
+        )
+        # Record the logical spec so the PS trainer can pack/unpack and
+        # drive the sparse optimizers.  `sow` so this is a no-op whenever
+        # the collection isn't mutable (i.e. everywhere except init).
+        self.sow(
+            SPECS_COLLECTION,
+            "spec",
+            jnp.array([spec.vocab_size, spec.dim], jnp.int32),
         )
         ids = jnp.asarray(ids).astype(jnp.int32)
         valid = ids >= 0
         safe_ids = jnp.where(valid, ids, 0)
         # NOTE: no stop_gradient here. Under the PS-mode trainer the table
         # is a closure constant of the loss (not a grad argument), so no
-        # dense [vocab, dim] cotangent is ever built — the sparse path owns
-        # the update.  Under the Local/AllReduce trainers the table is a
-        # normal param and trains by dense autodiff (correct for the small
-        # tables those modes are meant for).
-        acts = jnp.take(table, safe_ids, axis=0)
+        # dense cotangent is ever built — the sparse path owns the update.
+        # Under the Local/AllReduce trainers the table is a normal param
+        # and trains by dense autodiff through the packed lookup (correct
+        # for the small tables those modes are meant for).
+        acts = pk.lookup(spec, table, safe_ids.reshape((-1,))).reshape(
+            safe_ids.shape + (self.embedding_dim,)
+        )
         # Gradient capture point (the reference's tape.watch(bet)); must sit
         # BEFORE the validity mask so padding positions get zero gradient.
         acts = self.perturb("bet", acts)

@@ -75,9 +75,16 @@ class DataParallelTrainer:
 
         repl = shd.replicated(mesh)
         batch = shd.batch_sharded(mesh)
+        window = shd.window_sharded(mesh)
         self._train_step = jax.jit(
             self._train_step_impl,
             in_shardings=(repl, batch, batch, batch),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,),
+        )
+        self._train_window_jit = jax.jit(
+            self._train_window_impl,
+            in_shardings=(repl, window, window, window),
             out_shardings=(repl, repl),
             donate_argnums=(0,),
         )
@@ -115,13 +122,18 @@ class DataParallelTrainer:
 
     def ensure_initialized(self, features) -> TrainState:
         if self._state is None:
-            from elasticdl_tpu.layers.embedding import strip_capture_collections
+            from elasticdl_tpu.layers.embedding import (
+                export_spec_map,
+                strip_capture_collections,
+            )
             from elasticdl_tpu.worker.trainer import _unbox_partitioned
 
             rng = jax.random.PRNGKey(self._seed)
-            variables = strip_capture_collections(
-                dict(self._model.init(rng, jax.tree.map(jnp.asarray, features)))
+            variables = dict(
+                self._model.init(rng, jax.tree.map(jnp.asarray, features))
             )
+            self._export_specs = export_spec_map(variables)
+            variables = strip_capture_collections(variables)
             variables = _unbox_partitioned(variables)
             params = variables.pop("params")
             state = TrainState(
@@ -165,6 +177,16 @@ class DataParallelTrainer:
             loss,
         )
 
+    def _train_window_impl(self, state, feat_win, label_win, mask_win):
+        """K train steps in one device program (see ps_trainer)."""
+
+        def body(st, xs):
+            features, labels, mask = xs
+            new_state, loss = self._train_step_impl(st, features, labels, mask)
+            return new_state, loss
+
+        return jax.lax.scan(body, state, (feat_win, label_win, mask_win))
+
     def _eval_step_impl(self, state: TrainState, features):
         variables = {"params": state.params, **state.model_state}
         outputs, _ = _model_apply(
@@ -194,13 +216,42 @@ class DataParallelTrainer:
         """Collective-mode entry: `features`/`labels`/`mask` are this
         process's equal-size slice of the global batch (pre-padded by the
         caller); all processes must call this in lockstep."""
-        state = self.ensure_initialized(features)
-        features = shd.assemble_global_batch(features, self._mesh)
-        labels = shd.assemble_global_batch(labels, self._mesh)
-        mask = shd.assemble_global_batch(np.asarray(mask, np.float32), self._mesh)
-        self._state, loss = self._train_step(state, features, labels, mask)
+        self.ensure_initialized(features)
+        return self.train_step_staged(self.stage_batch(features, labels, mask))
+
+    def stage_batch(self, features, labels, mask):
+        """Async device placement of one lockstep batch (stage k+1 before
+        stepping k to overlap H2D with compute; see ps_trainer)."""
+        return (
+            shd.assemble_global_batch(features, self._mesh),
+            shd.assemble_global_batch(labels, self._mesh),
+            shd.assemble_global_batch(np.asarray(mask, np.float32), self._mesh),
+        )
+
+    def train_step_staged(self, staged):
+        state = self.ensure_initialized(staged[0])
+        self._state, loss = self._train_step(state, *staged)
         self._host_step += 1
         return loss
+
+    def stage_window(self, batches):
+        """Stage K same-shape (features, labels, mask) batches as one
+        stacked transfer (see ps_trainer.stage_window)."""
+        stacked_f, stacked_l, stacked_m = shd.stack_window(batches)
+        return (
+            shd.assemble_window(stacked_f, self._mesh),
+            shd.assemble_window(stacked_l, self._mesh),
+            shd.assemble_window(stacked_m, self._mesh),
+        )
+
+    def train_window(self, window):
+        """Run every batch of a staged window; returns the [K] losses."""
+        if self._state is None:
+            self.ensure_initialized(jax.tree.map(lambda x: x[0], window[0]))
+        k = jax.tree.leaves(window[1])[0].shape[0]
+        self._state, losses = self._train_window_jit(self._state, *window)
+        self._host_step += k
+        return losses
 
     def eval_step_local(self, features):
         """Collective-mode eval: local slice in, FULL global outputs out
@@ -224,11 +275,17 @@ class DataParallelTrainer:
         return None if self._state is None else jax.device_get(self._state)
 
     def get_variables_numpy(self) -> dict:
+        """Flat logical view; packed tables unpacked (see worker.trainer)."""
+        from elasticdl_tpu.parallel import packed as pk
+
         if self._state is None:
             return {}
+        specs = getattr(self, "_export_specs", {})
         flat = {}
         tree = {"params": self._state.params, **self._state.model_state}
         for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
             key = "/".join(str(getattr(p, "key", p)) for p in path)
+            if key in specs:
+                leaf = pk.unpack(specs[key], leaf)
             flat[key] = np.asarray(leaf)
         return flat

@@ -1,0 +1,192 @@
+"""Packed (lane-tiled) storage for narrow embedding tables.
+
+Why this exists — the TPU memory-layout problem for embedding tables:
+XLA tiles 2-D f32 arrays as T(8,128) (8 sublanes x 128 lanes).  A logical
+[vocab, dim] table with small dim (CTR models use 1..32) is hostile to
+that tiling either way:
+
+- row-major {1,0}: the minor (lane) dimension `dim` pads to 128 ->
+  128/dim x HBM blow-up (16x for dim=8).  XLA refuses.
+- column-major {0,1} (what XLA picks): one embedding row's `dim` floats
+  sit `vocab` elements apart, so every row gather/scatter touches `dim`
+  far-apart tiles.  Measured on the DeepFM step (SURVEY §2.5 config 4):
+  the three [2.6M, 8] scatter-adds of the sparse-Adam update ran ~6.3 ms
+  EACH — 19 ms of a 30 ms step.
+
+The fix is to make the physical shape lane-shaped: store the table as
+[vocab/R, 128] where R = 128/dim_padded rows pack into one 128-lane
+storage row.  Then:
+
+- lookup  = gather of full 512-byte storage rows (fast path) + a tiny
+  one-hot einsum to select the packed slot (MXU work, no per-element
+  gather — `take_along_axis` on lanes lowers to a serialized gather and
+  measured 250 ms for a batch; the einsum is ~0).
+- scatter = tile the update to 128 lanes, mask to the right slot, and
+  scatter-add full storage rows.
+- optimizer slot updates stream over the whole (sharded) table with a
+  touched-row mask instead of gather/update/scatter of individual rows
+  (see parallel/sparse_optim.py).
+
+Parity note: this module replaces the row-partitioned embedding storage
+of the reference's Go parameter server (elasticdl/pkg/ps/parameters.go,
+embedding.go — a hash map of vocab-row slices per PS pod).  The sharding
+story is unchanged (dim 0, now storage blocks, spreads over the mesh);
+only the per-device physical layout is TPU-shaped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANES = 128
+
+
+def _pad_dim(dim: int) -> int:
+    """Smallest power-of-two >= dim that divides 128, or a multiple of 128
+    for wide rows (which need no packing)."""
+    if dim >= LANES:
+        return -(-dim // LANES) * LANES
+    p = 1
+    while p < dim:
+        p *= 2
+    return p
+
+
+@dataclass(frozen=True)
+class PackedSpec:
+    """Static description of one packed table."""
+
+    vocab_size: int
+    dim: int
+
+    @property
+    def dim_padded(self) -> int:
+        return _pad_dim(self.dim)
+
+    @property
+    def rows_per_block(self) -> int:
+        return max(1, LANES // self.dim_padded)
+
+    @property
+    def vocab_padded(self) -> int:
+        r = self.rows_per_block
+        return -(-self.vocab_size // r) * r
+
+    @property
+    def num_blocks(self) -> int:
+        return self.vocab_padded // self.rows_per_block
+
+    @property
+    def block_width(self) -> int:
+        return self.rows_per_block * self.dim_padded  # == LANES for dim<128
+
+    @property
+    def packed_shape(self) -> tuple:
+        return (self.num_blocks, self.block_width)
+
+
+def pack(spec: PackedSpec, table):
+    """[vocab, dim] -> packed [num_blocks, block_width]."""
+    table = jnp.asarray(table)
+    v_pad = spec.vocab_padded - table.shape[0]
+    d_pad = spec.dim_padded - table.shape[1]
+    if v_pad or d_pad:
+        table = jnp.pad(table, ((0, v_pad), (0, d_pad)))
+    return table.reshape(spec.packed_shape)
+
+
+def unpack(spec: PackedSpec, packed):
+    """packed [num_blocks, block_width] -> logical [vocab, dim]."""
+    logical = jnp.asarray(packed).reshape(spec.vocab_padded, spec.dim_padded)
+    return logical[: spec.vocab_size, : spec.dim]
+
+
+def packed_init(spec: PackedSpec, initializer):
+    """Wrap a logical (key, (vocab, dim), dtype) initializer so it produces
+    the packed storage shape (flax param init shim)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        assert tuple(shape) == spec.packed_shape, (shape, spec)
+        logical = initializer(key, (spec.vocab_size, spec.dim), dtype)
+        return pack(spec, logical)
+
+    return init
+
+
+def lookup(spec: PackedSpec, packed, ids):
+    """Gather logical rows: ids [n] int32 -> [n, dim].
+
+    Storage-row gather (contiguous 512B rows) + one-hot einsum slot
+    select.  NEVER use take_along_axis here: lane-indexed gathers
+    serialize on TPU (measured 250 ms vs ~0 for the einsum).
+    """
+    r = spec.rows_per_block
+    d = spec.dim_padded
+    rows = jnp.take(packed, ids // r, axis=0)  # [n, block_width]
+    if r == 1:
+        return rows[:, : spec.dim]
+    rows = rows.reshape((-1, r, d))
+    sel = jax.nn.one_hot(ids % r, r, dtype=packed.dtype)  # [n, r]
+    # precision=HIGHEST: at default MXU precision this matmul would round
+    # the f32 table values to bf16 on every lookup (and its gradient).
+    # The selector contraction is tiny, so exactness costs nothing.
+    out = jnp.einsum(
+        "nrd,nr->nd", rows, sel, precision=jax.lax.Precision.HIGHEST
+    )
+    return out[:, : spec.dim]
+
+
+def expand_updates(spec: PackedSpec, ids, updates):
+    """(ids [n], updates [n, dim]) -> (block_ids [n], rows [n, block_width])
+    where each output row holds the update in its packed slot and zeros
+    elsewhere.  `scatter-add(packed, block_ids, rows)` then applies the
+    update with full-storage-row writes (duplicates sum, as scatter-add
+    must)."""
+    r = spec.rows_per_block
+    d = spec.dim_padded
+    n = ids.shape[0]
+    if spec.dim != d:
+        updates = jnp.pad(updates, ((0, 0), (0, d - spec.dim)))
+    if r == 1:
+        return ids, updates
+    tiled = jnp.tile(updates, (1, r))  # [n, block_width]; lane l holds updates[:, l % d]
+    lane_row = jnp.arange(spec.block_width, dtype=ids.dtype) // d  # [bw]
+    mask = (lane_row[None, :] == (ids % r)[:, None]).astype(updates.dtype)
+    return ids // r, tiled * mask
+
+
+def scatter_add(spec: PackedSpec, packed, ids, updates):
+    """packed[ids] += updates, packed-layout fast path."""
+    block_ids, rows = expand_updates(spec, ids, updates)
+    return packed.at[block_ids].add(rows)
+
+
+def grad_accumulate(spec: PackedSpec, packed_like, ids, grads):
+    """Segment-sum grads by row, in packed layout: returns acc with
+    acc[row] = sum of grads over every occurrence of that row in `ids`
+    (zeros elsewhere).  This IS the dedup: duplicate ids sum, exactly like
+    the reference's IndexedSlices -> unsorted_segment_sum before its Eigen
+    sparse-apply kernels (elasticdl/pkg/kernel/capi)."""
+    block_ids, rows = expand_updates(spec, ids, grads)
+    return jnp.zeros_like(packed_like).at[block_ids].add(rows)
+
+
+def touched_mask(spec: PackedSpec, acc):
+    """[num_blocks, rows_per_block] bool: rows whose summed gradient is
+    nonzero.  Zero-summed rows (padding ids, fully-masked batches, exact
+    cancellation) must not decay optimizer moments — same contract as the
+    sorted-dedup implementation this replaced."""
+    r = spec.rows_per_block
+    d = spec.dim_padded
+    return jnp.any(acc.reshape((-1, r, d)) != 0, axis=-1)
+
+
+def broadcast_rows(spec: PackedSpec, per_row):
+    """[num_blocks, rows_per_block] -> [num_blocks, block_width] by
+    repeating each row value across its dim lanes (elementwise-streaming
+    friendly; no gathers)."""
+    return jnp.repeat(per_row, spec.dim_padded, axis=1, total_repeat_length=spec.block_width)

@@ -33,8 +33,11 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.layers.embedding import (
     IDS_COLLECTION,
     PERTURBATIONS,
+    SPECS_COLLECTION,
     VOCAB_AXIS,
 )
+from elasticdl_tpu.parallel import packed as pk
+from elasticdl_tpu.parallel.packed import PackedSpec
 from elasticdl_tpu.parallel import sharding as shd
 from elasticdl_tpu.parallel.dp_trainer import per_example_loss_fn
 from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -131,23 +134,28 @@ class ShardedEmbeddingTrainer:
 
     # -- sharding layout -----------------------------------------------
 
-    def _table_sharding(self, ndim: int):
+    def _table_sharding(self, dim0: int, ndim: int):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        # Rows across the WHOLE mesh: maximum HBM capacity, the analogue of
-        # partitioning one table over every PS pod.
+        # Storage blocks across the WHOLE mesh: maximum HBM capacity, the
+        # analogue of partitioning one table over every PS pod.  Tables too
+        # small to split evenly (fewer blocks than devices) replicate — they
+        # are by definition tiny.
+        total = int(self._mesh.devices.size)
+        if dim0 % total != 0:
+            return shd.replicated(self._mesh)
         spec = P((DATA_AXIS, MODEL_AXIS), *([None] * (ndim - 1)))
         return NamedSharding(self._mesh, spec)
 
     def _state_shardings(self, state: PSTrainState):
         repl = shd.replicated(self._mesh)
         tables = {
-            key: self._table_sharding(np.ndim(value))
+            key: self._table_sharding(np.shape(value)[0], np.ndim(value))
             for key, value in state.tables.items()
         }
         slots = {
             key: {
-                name: self._table_sharding(np.ndim(value))
+                name: self._table_sharding(np.shape(value)[0], np.ndim(value))
                 for name, value in group.items()
             }
             for key, group in state.slots.items()
@@ -195,11 +203,13 @@ class ShardedEmbeddingTrainer:
         params_boxed = variables.pop("params")
         variables.pop(IDS_COLLECTION, None)
         perturbs = variables.pop(PERTURBATIONS, {})
+        specs_tree = variables.pop(SPECS_COLLECTION, {})
         model_state = variables
 
         # Split tables (VOCAB_AXIS-marked Partitioned leaves) from dense.
         tables: Dict[str, jnp.ndarray] = {}
         self._table_paths = {}
+        self._table_specs: Dict[str, PackedSpec] = {}
 
         def split(path, leaf):
             if (
@@ -222,8 +232,17 @@ class ShardedEmbeddingTrainer:
         params = jax.tree_util.tree_unflatten(
             flat[1], [split(p, v) for p, v in flat[0]]
         )
+        for key, module_path in self._table_paths.items():
+            spec_arr = np.asarray(
+                _collection_get(specs_tree, module_path[:-1], "spec")
+            )
+            self._table_specs[key] = PackedSpec(int(spec_arr[0]), int(spec_arr[1]))
+            assert tables[key].shape == self._table_specs[key].packed_shape, (
+                key, tables[key].shape, self._table_specs[key],
+            )
         slots = {
-            key: self._emb_tx.init_slots(table) for key, table in tables.items()
+            key: self._emb_tx.init_slots(self._table_specs[key], table)
+            for key, table in tables.items()
         }
         self._perturb_shapes = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), _unbox(perturbs)
@@ -260,10 +279,17 @@ class ShardedEmbeddingTrainer:
     def _compile_steps(self):
         repl = shd.replicated(self._mesh)
         batch = shd.batch_sharded(self._mesh)
+        window = shd.window_sharded(self._mesh)
         state_shardings = self._state_shardings(self._state)
         self._train_step = jax.jit(
             self._train_step_impl,
             in_shardings=(state_shardings, batch, batch, batch),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=(0,),
+        )
+        self._train_window = jax.jit(
+            self._train_window_impl,
+            in_shardings=(state_shardings, window, window, window),
             out_shardings=(state_shardings, repl),
             donate_argnums=(0,),
         )
@@ -320,13 +346,13 @@ class ShardedEmbeddingTrainer:
         new_slots = dict(state.slots)
         for key, module_path in self._table_paths.items():
             prefix = module_path[:-1]  # drop the 'embedding' param name
+            spec = self._table_specs[key]
             ids = _collection_get(ids_tree, prefix, "ids")
             grad = _collection_get(perturb_grads, prefix, "bet")
-            dim = new_tables[key].shape[-1]
             flat_ids = ids.reshape((-1,))
-            flat_grads = grad.reshape((-1, dim)).astype(new_tables[key].dtype)
+            flat_grads = grad.reshape((-1, spec.dim)).astype(new_tables[key].dtype)
             new_tables[key], new_slots[key] = self._emb_tx.apply(
-                new_tables[key], new_slots[key], flat_ids, flat_grads
+                spec, new_tables[key], new_slots[key], flat_ids, flat_grads
             )
 
         new_model_state = (
@@ -344,6 +370,18 @@ class ShardedEmbeddingTrainer:
             ),
             loss,
         )
+
+    def _train_window_impl(self, state, feat_win, label_win, mask_win):
+        """K train steps in ONE device program (lax.scan over the stacked
+        window).  One dispatch + one transfer amortize per-call overheads
+        K-fold — the TPU-idiomatic device-side training loop."""
+
+        def body(st, xs):
+            features, labels, mask = xs
+            new_state, loss = self._train_step_impl(st, features, labels, mask)
+            return new_state, loss
+
+        return jax.lax.scan(body, state, (feat_win, label_win, mask_win))
 
     def _eval_step_impl(self, state: PSTrainState, features):
         variables = {
@@ -368,13 +406,59 @@ class ShardedEmbeddingTrainer:
         return self.train_step_local(features, labels, mask)
 
     def train_step_local(self, features, labels, mask):
-        state = self.ensure_initialized(features)
-        features = shd.assemble_global_batch(features, self._mesh)
-        labels = shd.assemble_global_batch(labels, self._mesh)
-        mask = shd.assemble_global_batch(np.asarray(mask, np.float32), self._mesh)
-        self._state, loss = self._train_step(state, features, labels, mask)
+        self.ensure_initialized(features)
+        return self.train_step_staged(self.stage_batch(features, labels, mask))
+
+    def stage_batch(self, features, labels, mask):
+        """Asynchronously place one lockstep batch on the mesh.  Staging
+        returns immediately (device transfers are async), so staging batch
+        k+1 BEFORE stepping batch k overlaps host->device traffic with
+        compute — on hosts where the transfer is the bottleneck this is
+        the difference between step-time and transfer-time throughput."""
+        return (
+            shd.assemble_global_batch(features, self._mesh),
+            shd.assemble_global_batch(labels, self._mesh),
+            shd.assemble_global_batch(np.asarray(mask, np.float32), self._mesh),
+        )
+
+    def train_step_staged(self, staged):
+        if self._state is None:
+            # Init derives perturbation shapes from LOCAL batch shapes;
+            # staged batches are already global, so init must happen first
+            # (train_step_local does this; direct stagers call
+            # ensure_initialized themselves).
+            raise RuntimeError(
+                "train_step_staged requires ensure_initialized(features) first"
+            )
+        self._state, loss = self._train_step(self._state, *staged)
         self._host_step += 1
         return loss
+
+    def stage_window(self, batches):
+        """Stage K lockstep (features, labels, mask) batches in ONE
+        host->device transfer: [K, batch, ...] stacks, batch dim sharded.
+        Per-transfer overhead (dominant on thin hosts) amortizes K-fold;
+        `train_window(window)` then runs all K steps in one device
+        program.  All K batches must share shapes (callers route ragged
+        tails through `train_step_staged`)."""
+        stacked_f, stacked_l, stacked_m = shd.stack_window(batches)
+        return (
+            shd.assemble_window(stacked_f, self._mesh),
+            shd.assemble_window(stacked_l, self._mesh),
+            shd.assemble_window(stacked_m, self._mesh),
+        )
+
+    def train_window(self, window):
+        """Run every batch of a staged window; returns the [K] losses
+        (device array — don't block on it in the hot loop)."""
+        if self._state is None:
+            raise RuntimeError(
+                "train_window requires ensure_initialized(features) first"
+            )
+        k = jax.tree.leaves(window[1])[0].shape[0]
+        self._state, losses = self._train_window(self._state, *window)
+        self._host_step += k
+        return losses
 
     def eval_step(self, features):
         n = jax.tree.leaves(features)[0].shape[0]
@@ -409,13 +493,18 @@ class ShardedEmbeddingTrainer:
         )
 
     def get_variables_numpy(self) -> dict:
+        """Flat {path: logical np.ndarray} — packed tables are unpacked to
+        their [vocab, dim] shape (the export/serving view)."""
         if self._state is None:
             return {}
         state = self._state
         flat = {}
         merged = self._merge_params(
             jax.device_get(state.params),
-            {k: jax.device_get(v) for k, v in state.tables.items()},
+            {
+                k: np.asarray(pk.unpack(self._table_specs[k], jax.device_get(v)))
+                for k, v in state.tables.items()
+            },
         )
         tree = {"params": merged, **jax.device_get(state.model_state)}
         for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:

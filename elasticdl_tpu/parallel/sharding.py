@@ -29,6 +29,15 @@ def batch_sharded(mesh):
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def window_sharded(mesh):
+    """[window, batch, ...]: dim 1 (batch) sharded over the data axis.
+    Used by the windowed staging path — K batches ride ONE host->device
+    transfer and the step function dynamic-slices batch k on device."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
 def data_axis_size(mesh) -> int:
     return mesh.shape[DATA_AXIS]
 
@@ -98,6 +107,38 @@ def assemble_global_batch(tree: Any, mesh):
     import jax
 
     sharding = batch_sharded(mesh)
+    if jax.process_count() == 1:
+        return jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), sharding), tree
+        )
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(x)
+        ),
+        tree,
+    )
+
+
+def stack_window(batches):
+    """Host-stack K (features, labels, mask) batches into [K, ...] arrays
+    for assemble_window (shared by the PS and DP trainers' stage_window)."""
+    import jax
+
+    feats = [b[0] for b in batches]
+    stacked_f = jax.tree.map(lambda *xs: np.stack(xs), *feats)
+    stacked_l = np.stack([np.asarray(b[1]) for b in batches])
+    stacked_m = np.stack([np.asarray(b[2], np.float32) for b in batches])
+    return stacked_f, stacked_l, stacked_m
+
+
+def assemble_window(tree: Any, mesh):
+    """Like assemble_global_batch for a stacked window [K, batch, ...]:
+    dim 1 is the (global) batch.  One transfer carries K minibatches —
+    per-transfer overhead amortizes K-fold, and the windowed step slices
+    batch k on device."""
+    import jax
+
+    sharding = window_sharded(mesh)
     if jax.process_count() == 1:
         return jax.tree.map(
             lambda x: jax.device_put(np.asarray(x), sharding), tree

@@ -65,6 +65,7 @@ class CollectiveWorker:
         self._report_every = report_version_every_steps
         self._wait_sleep_s = wait_sleep_s
         self._last_reported_version = 0
+        self._last_ckpt_step = 0
         # Task-type -> reader: evaluation/prediction shards address their
         # own data sources when configured.
         self._readers = {
@@ -97,6 +98,9 @@ class CollectiveWorker:
         state, step = self._ckpt.load_latest()
         if state is not None:
             self._trainer.state = state
+            # Seed the delta cadence so a restart doesn't trigger a
+            # spurious full-state checkpoint one window after restore.
+            self._last_ckpt_step = step
             logger.info(
                 "Rank %d restored checkpoint at step %d", self._world.rank, step
             )
@@ -192,19 +196,49 @@ class CollectiveWorker:
                 labels, _ = shd.pad_batch(labels, self._block)
             yield features, labels, mask, global_real
 
+    # Batches per device dispatch on the training fast path.  All of a
+    # task's batches share one padded shape, so full windows hit a single
+    # compiled scan program; the tail (< WINDOW batches) reuses the
+    # single-step program — exactly two executables total.
+    WINDOW = 8
+
     def _process_train_task(self, task) -> dict:
         batch_count = 0
         record_count = 0
         last_loss = None
+        pending: list = []
+        pending_real = 0
+
+        def flush():
+            nonlocal batch_count, record_count, pending, pending_real, last_loss
+            if not pending:
+                return
+            if len(pending) == self.WINDOW and hasattr(
+                self._trainer, "stage_window"
+            ):
+                window = self._trainer.stage_window(pending)
+                losses = self._trainer.train_window(window)
+                last_loss = losses[-1]
+            else:
+                for staged_batch in pending:
+                    last_loss = self._trainer.train_step_staged(
+                        self._trainer.stage_batch(*staged_batch)
+                    )
+            batch_count += len(pending)
+            record_count += pending_real
+            pending, pending_real = [], 0
+            self._report_version_if_due()
+            self._maybe_checkpoint()
+
         for features, labels, mask, global_real in self._local_batches(
             task, Mode.TRAINING
         ):
-            last_loss = self._trainer.train_step_local(features, labels, mask)
-            batch_count += 1
-            record_count += global_real
-            if self._trainer.step % self._report_every == 0:
-                self._report_version()
-            self._maybe_checkpoint()
+            self._trainer.ensure_initialized(features)
+            pending.append((features, labels, mask))
+            pending_real += global_real
+            if len(pending) == self.WINDOW:
+                flush()
+        flush()
         if last_loss is not None and self._world.is_leader:
             logger.info(
                 "task %d done: step=%d loss=%.5f (%d global batches)",
@@ -271,6 +305,12 @@ class CollectiveWorker:
 
     # ------------------------------------------------------------------
 
+    def _report_version_if_due(self):
+        """Window-safe cadence: steps advance in jumps of WINDOW, so the
+        trigger is a delta since the last report, not an exact multiple."""
+        if self._trainer.step - self._last_reported_version >= self._report_every:
+            self._report_version()
+
     def _report_version(self, force: bool = False):
         if not self._world.is_leader:
             return
@@ -281,12 +321,16 @@ class CollectiveWorker:
 
     def _maybe_checkpoint(self, force: bool = False):
         """Every rank computes the save decision identically and joins the
-        host-gather (a collective for sharded tables); only rank 0 writes."""
+        host-gather (a collective for sharded tables); only rank 0 writes.
+        Delta-based cadence (steps can jump by WINDOW at a time)."""
         if self._ckpt is None or self._trainer.state is None:
             return
         step = self._trainer.step
-        due = force or (self._ckpt_steps and step % self._ckpt_steps == 0)
-        if due and step > 0:
+        due = force or (
+            self._ckpt_steps and step - self._last_ckpt_step >= self._ckpt_steps
+        )
+        if due and step > 0 and step != self._last_ckpt_step:
             host_state = self._trainer.state_to_host()
             if self._world.is_leader:
                 self._ckpt.save(host_state, step)
+            self._last_ckpt_step = step

@@ -84,11 +84,15 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def _init_state(self, features) -> TrainState:
-        from elasticdl_tpu.layers.embedding import strip_capture_collections
+        from elasticdl_tpu.layers.embedding import (
+            export_spec_map,
+            strip_capture_collections,
+        )
 
         rng = jax.random.PRNGKey(self._seed)
-        variables = self._model.init(rng, jax.tree.map(jnp.asarray, features))
-        variables = strip_capture_collections(dict(variables))
+        variables = dict(self._model.init(rng, jax.tree.map(jnp.asarray, features)))
+        self._export_specs = export_spec_map(variables)
+        variables = strip_capture_collections(variables)
         params = _unbox_partitioned(variables.pop("params"))
         model_state = _unbox_partitioned(variables)  # batch_stats etc
         opt_state = self._tx.init(params)
@@ -161,13 +165,20 @@ class Trainer:
         return self._eval_step(state, features)
 
     def get_variables_numpy(self) -> dict:
-        """Flat {path: np.ndarray} view of all variables (for export/ckpt)."""
+        """Flat {path: np.ndarray} view of all variables (for export/ckpt).
+        Packed embedding tables are unpacked to their logical [vocab, dim]
+        export view (same contract as the PS trainer)."""
+        from elasticdl_tpu.parallel import packed as pk
+
         state = self._state
         if state is None:
             return {}
+        specs = getattr(self, "_export_specs", {})
         flat = {}
         tree = {"params": state.params, **state.model_state}
         for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
             key = "/".join(str(getattr(p, "key", p)) for p in path)
+            if key in specs:
+                leaf = pk.unpack(specs[key], leaf)
             flat[key] = np.asarray(leaf)
         return flat

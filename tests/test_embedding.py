@@ -41,8 +41,8 @@ class TestSparseOptimizers:
 
     def test_sgd_matches_segment_summed_update(self):
         opt = sparse_optim.sgd(0.1)
-        new_table, _ = opt.apply(
-            jnp.asarray(self.table), opt.init_slots(jnp.asarray(self.table)),
+        new_table, _ = opt.apply_logical(
+            jnp.asarray(self.table), opt.init_slots_logical(jnp.asarray(self.table)),
             jnp.asarray(self.ids), jnp.asarray(self.grads),
         )
         expected = self.table.copy()
@@ -52,8 +52,8 @@ class TestSparseOptimizers:
 
     def test_adagrad_matches_golden(self):
         opt = sparse_optim.adagrad(0.1, epsilon=1e-7)
-        slots = opt.init_slots(jnp.asarray(self.table))
-        new_table, new_slots = opt.apply(
+        slots = opt.init_slots_logical(jnp.asarray(self.table))
+        new_table, new_slots = opt.apply_logical(
             jnp.asarray(self.table), slots,
             jnp.asarray(self.ids), jnp.asarray(self.grads),
         )
@@ -63,19 +63,23 @@ class TestSparseOptimizers:
             acc[row] += g * g
             expected[row] -= 0.1 * g / (np.sqrt(acc[row]) + 1e-7)
         np.testing.assert_allclose(np.asarray(new_table), expected, rtol=1e-5)
+        from elasticdl_tpu.parallel.packed import PackedSpec
+        from elasticdl_tpu.parallel import packed as pk
+
+        spec = PackedSpec(VOCAB, DIM)
         np.testing.assert_allclose(
-            np.asarray(new_slots["accumulator"]), acc, rtol=1e-6
+            np.asarray(pk.unpack(spec, new_slots["accumulator"])), acc, rtol=1e-6
         )
 
     def test_momentum_matches_golden(self):
         opt = sparse_optim.momentum(0.1, mu=0.9)
-        slots = opt.init_slots(jnp.asarray(self.table))
-        table, slots = opt.apply(
+        slots = opt.init_slots_logical(jnp.asarray(self.table))
+        table, slots = opt.apply_logical(
             jnp.asarray(self.table), slots,
             jnp.asarray(self.ids), jnp.asarray(self.grads),
         )
         # Second apply exercises existing momentum.
-        table, slots = opt.apply(
+        table, slots = opt.apply_logical(
             table, slots, jnp.asarray(self.ids), jnp.asarray(self.grads)
         )
         expected = self.table.copy()
@@ -88,8 +92,8 @@ class TestSparseOptimizers:
 
     def test_adam_matches_golden(self):
         opt = sparse_optim.adam(0.01, 0.9, 0.999, 1e-8)
-        slots = opt.init_slots(jnp.asarray(self.table))
-        table, slots = opt.apply(
+        slots = opt.init_slots_logical(jnp.asarray(self.table))
+        table, slots = opt.apply_logical(
             jnp.asarray(self.table), slots,
             jnp.asarray(self.ids), jnp.asarray(self.grads),
         )
@@ -111,8 +115,11 @@ class TestSparseOptimizers:
 
 class TestEmbeddingLayer:
     def _apply(self, layer, ids):
+        from elasticdl_tpu.parallel import packed as pk
+
         variables = layer.init(jax.random.PRNGKey(0), ids)
-        table = variables["params"]["embedding"].unbox()
+        packed_table = variables["params"]["embedding"].unbox()
+        table = pk.unpack(layer.spec, packed_table)  # logical [vocab, dim]
         out = layer.apply(variables, ids)
         return np.asarray(table), np.asarray(out)
 
@@ -303,16 +310,34 @@ def test_masked_batch_does_not_touch_adam_slots():
     untouched (padding rows must not drift)."""
     opt = sparse_optim.adam(0.01)
     table = jnp.asarray(np.random.RandomState(0).rand(8, 4).astype(np.float32))
-    slots = opt.init_slots(table)
+    slots = opt.init_slots_logical(table)
     # Prime row 2 with a real update.
     ids = jnp.asarray([2], jnp.int32)
     g = jnp.ones((1, 4), jnp.float32)
-    table1, slots1 = opt.apply(table, slots, ids, g)
+    table1, slots1 = opt.apply_logical(table, slots, ids, g)
     # Zero-grad (masked) step touching rows 2 and 0.
-    table2, slots2 = opt.apply(
+    table2, slots2 = opt.apply_logical(
         table1, slots1, jnp.asarray([2, 0], jnp.int32),
         jnp.zeros((2, 4), jnp.float32),
     )
     np.testing.assert_array_equal(np.asarray(table2), np.asarray(table1))
     np.testing.assert_array_equal(np.asarray(slots2["m"]), np.asarray(slots1["m"]))
     np.testing.assert_array_equal(np.asarray(slots2["t"]), np.asarray(slots1["t"]))
+
+
+def test_dense_trainer_exports_logical_table_shape():
+    """Export from the Local/AllReduce path must show [vocab, dim], not the
+    packed storage shape (same contract as the PS trainer)."""
+    trainer = Trainer(SparseModel(), _loss, optax.sgd(0.1), seed=0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, size=(8, 3)).astype(np.int32)
+    labels = rng.randint(0, 4, size=8).astype(np.int32)
+    trainer.train_step(ids, labels)
+    assert trainer.get_variables_numpy()["params/emb/embedding"].shape == (VOCAB, DIM)
+
+    from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+
+    mesh = build_mesh(MeshConfig())
+    dp = DataParallelTrainer(SparseModel(), _loss, optax.sgd(0.1), mesh)
+    dp.train_step(ids, labels)
+    assert dp.get_variables_numpy()["params/emb/embedding"].shape == (VOCAB, DIM)

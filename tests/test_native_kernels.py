@@ -81,7 +81,7 @@ def test_sparse_kernels_match_jax_path(kernels, data, name):
         "adam": sparse_optim.adam(0.01),
     }[name]
     jt = jnp.asarray(data["table"])
-    slots = jax_opt.init_slots(jt)
+    slots = jax_opt.init_slots_logical(jt)
 
     velocity = np.zeros_like(table_native)
     accum = np.zeros_like(table_native)
@@ -93,8 +93,8 @@ def test_sparse_kernels_match_jax_path(kernels, data, name):
     for _ in range(3):
         grads = rng.rand(5, DIM).astype(np.float32)
         ids32 = data["ids"].astype(np.int32)
-        jt, slots = jax_opt.apply(jt, slots, jnp.asarray(ids32),
-                                  jnp.asarray(grads))
+        jt, slots = jax_opt.apply_logical(jt, slots, jnp.asarray(ids32),
+                                          jnp.asarray(grads))
         if name == "sgd":
             kernels.sgd_sparse(table_native, data["ids"], grads, 0.1)
         elif name == "momentum":
@@ -108,19 +108,26 @@ def test_sparse_kernels_match_jax_path(kernels, data, name):
                                 grads, 0.01)
     np.testing.assert_allclose(table_native, np.asarray(jt), rtol=1e-4,
                                atol=1e-6)
+    from elasticdl_tpu.parallel import packed as pk
+    from elasticdl_tpu.parallel.packed import PackedSpec
+
+    spec = PackedSpec(VOCAB, DIM)
     if name == "momentum":
         np.testing.assert_allclose(
-            velocity, np.asarray(slots["momentum"]), rtol=1e-4, atol=1e-6
+            velocity, np.asarray(pk.unpack(spec, slots["momentum"])),
+            rtol=1e-4, atol=1e-6,
         )
     if name == "adagrad":
         np.testing.assert_allclose(
-            accum, np.asarray(slots["accumulator"]), rtol=1e-4, atol=1e-6
+            accum, np.asarray(pk.unpack(spec, slots["accumulator"])),
+            rtol=1e-4, atol=1e-6,
         )
     if name == "adam":
-        np.testing.assert_allclose(m, np.asarray(slots["m"]), rtol=1e-4,
-                                   atol=1e-6)
         np.testing.assert_allclose(
-            t_rows, np.asarray(slots["t"]).astype(np.int64)
+            m, np.asarray(pk.unpack(spec, slots["m"])), rtol=1e-4, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            t_rows, np.asarray(slots["t"]).astype(np.int64)[:VOCAB]
         )
 
 
