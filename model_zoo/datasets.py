@@ -58,6 +58,46 @@ def synthetic_cifar10_reader(n: int = 4096, seed: int = 0, shard_name="cifar-syn
     return NumpyDataReader(images, labels, shard_name=shard_name)
 
 
+def synthetic_imagenet_reader(
+    n: int = 1024,
+    seed: int = 0,
+    image_size: int = 224,
+    num_classes: int = 1000,
+    shard_name: str = "imagenet-synth",
+):
+    """ImageNet-shaped learnable synthetic data: image_size^2 x3 uint8
+    images with a class-dependent bright patch (position/channel derived
+    from the label), so accuracy genuinely moves.  Images are generated
+    lazily per record to keep memory bounded at 224x224x3."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    seeds = rng.integers(0, 2**31 - 1, size=n)
+
+    grid = max(1, image_size // 16)
+
+    def make_image(i: int) -> np.ndarray:
+        r = np.random.default_rng(int(seeds[i]))
+        image = r.integers(0, 64, size=(image_size, image_size, 3)).astype(
+            np.uint8
+        )
+        cls = int(labels[i])
+        row = (cls // grid) % grid * 16
+        col = (cls % grid) * 16
+        channel = cls % 3
+        image[row : row + 12, col : col + 12, channel] = 220
+        return image
+
+    class _ImagenetReader(AbstractDataReader):
+        def create_shards(self):
+            return {shard_name: n}
+
+        def read_records(self, task):
+            for i in range(task.start, min(task.end, n)):
+                yield make_image(i), labels[i]
+
+    return _ImagenetReader()
+
+
 def synthetic_ctr_reader(
     n: int = 4096,
     num_dense: int = 13,

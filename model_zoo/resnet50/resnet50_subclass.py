@@ -1,0 +1,151 @@
+"""ResNet-50 ImageNet — model-zoo contract, JAX/flax body.
+
+Parity: model_zoo/resnet50_subclass/ in the reference (a Keras subclass
+ResNet-50 for ImageNet; BASELINE config 5 and the second headline metric,
+`resnet50_images_per_sec_per_chip`).  Same contract functions, TPU-first
+body:
+
+- Bottleneck v1.5 architecture (stride-2 on the 3x3 conv, the variant
+  every published ImageNet benchmark uses).
+- bfloat16 compute / float32 params+BN statistics — the standard TPU
+  mixed-precision recipe; all convs lower onto the MXU.
+- Batch-norm state rides the TrainState's mutable collections exactly
+  like the CIFAR-10 config (worker/trainer.py handles any mutable
+  collection generically).
+- `synthetic://imagenet?n=N` data paths serve shape-correct learnable
+  synthetic ImageNet (no network egress in CI), matching the reference's
+  practice of benchmarking config 5 with synthetic inputs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from model_zoo import datasets
+
+Dtype = Any
+
+IMAGE_SIZE = 224
+NUM_CLASSES = 1000
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut (v1.5:
+    stride lives on the 3x3)."""
+
+    filters: int
+    strides: int = 1
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last BN scale so each block starts as identity
+        # (the standard ResNet-50 trainability trick).
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = NUM_CLASSES
+    dtype: Dtype = jnp.bfloat16
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32,
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, blocks in enumerate(self.stage_sizes):
+            filters = 64 * (2 ** stage)
+            for block in range(blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(filters, strides, self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model(num_classes: int = NUM_CLASSES, use_bf16: bool = True):
+    return ResNet50(
+        num_classes=num_classes,
+        dtype=jnp.bfloat16 if use_bf16 else jnp.float32,
+    )
+
+
+def loss(labels, predictions):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions.astype(jnp.float32), labels.astype(jnp.int32)
+    ).mean()
+
+
+def optimizer(lr: float = 0.1):
+    return optax.sgd(lr, momentum=0.9, nesterov=True)
+
+
+def dataset_fn(dataset, mode, metadata):
+    mean = np.asarray([0.485, 0.456, 0.406], np.float32)
+    std = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+    def parse(record):
+        image, label = record
+        image = (np.asarray(image, np.float32) / 255.0 - mean) / std
+        return image, np.int32(label)
+
+    dataset = dataset.map(parse)
+    if mode == "training":
+        dataset = dataset.shuffle(1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda outputs, labels: np.mean(
+            np.argmax(outputs, axis=1) == labels.astype(np.int64)
+        ),
+        "loss": lambda outputs, labels: float(
+            loss(jnp.asarray(labels), jnp.asarray(outputs))
+        ),
+    }
+
+
+def custom_data_reader(data_path: str, **kwargs):
+    name, params = datasets.parse_synthetic_path(data_path)
+    if name is None:
+        return None
+    return datasets.synthetic_imagenet_reader(
+        n=params.get("n", 1024),
+        seed=params.get("seed", 0),
+        image_size=params.get("size", IMAGE_SIZE),
+        num_classes=params.get("classes", NUM_CLASSES),
+    )
