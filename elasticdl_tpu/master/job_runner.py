@@ -24,9 +24,39 @@ from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
 logger = get_logger("master.job_runner")
 
 
+def _ensure_elastic_checkpointing(args, mode: str):
+    """Churn recovery is restart-the-world + restore-latest: without a
+    checkpoint, a re-formed world re-initializes weights while the
+    TaskManager keeps finished tasks finished — silently discarding all
+    learned state (reference keeps state alive on surviving Horovod
+    workers, so it never has this failure mode).  Elastic training jobs
+    therefore get checkpointing by default: a job-scoped temp dir when
+    none is configured, and a sane save cadence when one is."""
+    if mode != Mode.TRAINING or not args.need_elasticity:
+        return
+    if not args.checkpoint_dir:
+        args.checkpoint_dir = tempfile.mkdtemp(
+            prefix=f"{args.job_name}_ckpt_"
+        )
+        logger.warning(
+            "Elastic job has no --checkpoint_dir; worker churn would "
+            "silently reset model weights while task progress survives. "
+            "Defaulting to %s — set --checkpoint_dir to keep snapshots.",
+            args.checkpoint_dir,
+        )
+    if not args.checkpoint_steps:
+        args.checkpoint_steps = 100
+        logger.warning(
+            "Elastic job has --checkpoint_steps=0; defaulting to %d so "
+            "re-formed worlds restore recent state.",
+            args.checkpoint_steps,
+        )
+
+
 def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
     """AllReduce strategy: N worker processes form a jax.distributed world;
     gradients psum inside the compiled step; churn re-forms the world."""
+    _ensure_elastic_checkpointing(args, mode)
     rendezvous = ElasticRendezvous()
     master = start_master(args, rendezvous_server=rendezvous)
     if mode == Mode.EVALUATION:

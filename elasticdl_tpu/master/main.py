@@ -8,6 +8,7 @@ assembly used by Local mode and by tests.
 
 from __future__ import annotations
 
+import os
 import sys
 from dataclasses import dataclass
 from typing import Optional
@@ -19,7 +20,7 @@ from elasticdl_tpu.common.model_utils import load_model_spec
 from elasticdl_tpu.data.reader import build_data_reader
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.servicer import MasterServicer, start_master_server
-from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.master.task_manager import TaskManager, TaskProgressPersister
 
 logger = get_logger("master.main")
 
@@ -35,12 +36,19 @@ class Master:
     port: int = 0
     rendezvous_server: object = None
     data_reader: object = None
+    progress_persister: object = None
 
     @property
     def addr(self) -> str:
         return f"localhost:{self.port}"
 
     def stop(self):
+        if self.progress_persister is not None:
+            try:
+                self.progress_persister.stop()
+            except Exception:
+                logger.exception("Final task-progress persist failed")
+            self.progress_persister = None
         if self.server is not None:
             self.server.stop(grace=None)
 
@@ -68,14 +76,50 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
         pred_reader = build_data_reader(args, model_spec, args.prediction_data)
         prediction_shards = pred_reader.create_shards()
 
-    task_manager = TaskManager(
-        training_shards=training_shards,
-        evaluation_shards=evaluation_shards,
-        prediction_shards=prediction_shards,
-        records_per_task=args.records_per_task,
-        num_epochs=args.num_epochs,
-        task_timeout_s=args.task_timeout_s,
+    # Master restart resume: a prior master's shard-progress snapshot (in
+    # checkpoint_dir) takes precedence over fresh task creation, so a
+    # restarted master continues the epoch instead of replaying it.
+    # Cluster strategies only — in Local mode the "master" lives and dies
+    # with the job, and resuming a *finished* run's snapshot would turn a
+    # re-run into an instant no-op.
+    task_manager = None
+    progress_path = (
+        TaskProgressPersister.progress_path(args.checkpoint_dir)
+        if getattr(args, "checkpoint_dir", "")
+        and args.distribution_strategy != DistributionStrategy.LOCAL
+        else ""
     )
+    if progress_path and os.path.exists(progress_path):
+        try:
+            with open(progress_path) as f:
+                content = f.read()
+            task_manager = TaskManager.from_checkpoint(
+                content, task_timeout_s=args.task_timeout_s
+            )
+            counts = task_manager.counts()
+            logger.info(
+                "Resumed task progress from %s (epoch %d, %d tasks todo, "
+                "%d records finished)",
+                progress_path,
+                counts["epoch"],
+                counts["todo"],
+                task_manager.finished_record_count,
+            )
+        except Exception:
+            logger.exception(
+                "Unreadable task-progress snapshot %s; starting fresh",
+                progress_path,
+            )
+            task_manager = None
+    if task_manager is None:
+        task_manager = TaskManager(
+            training_shards=training_shards,
+            evaluation_shards=evaluation_shards,
+            prediction_shards=prediction_shards,
+            records_per_task=args.records_per_task,
+            num_epochs=args.num_epochs,
+            task_timeout_s=args.task_timeout_s,
+        )
 
     evaluation_service = None
     if model_spec.eval_metrics_fn is not None and evaluation_shards:
@@ -105,6 +149,11 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
     if model_spec.callbacks is not None and training_shards:
         # Queue the TRAIN_END_CALLBACK task so zoo callbacks() actually run.
         task_manager.add_tasks_done_callback(task_manager.create_train_end_task)
+    progress_persister = None
+    if progress_path:
+        progress_persister = TaskProgressPersister(
+            task_manager, args.checkpoint_dir
+        ).start()
     master = Master(
         args=args,
         model_spec=model_spec,
@@ -113,6 +162,7 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
         servicer=servicer,
         rendezvous_server=rendezvous_server,
         data_reader=training_reader,
+        progress_persister=progress_persister,
     )
     return master
 

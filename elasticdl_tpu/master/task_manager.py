@@ -389,22 +389,100 @@ class TaskManager:
                     "finished_record_count": self._finished_record_count,
                     "training_shards": self._training_shards,
                     "evaluation_shards": self._evaluation_shards,
+                    "prediction_shards": self._prediction_shards,
                     "todo": todo,
                 }
             )
 
     @classmethod
-    def from_checkpoint(cls, content: str, task_timeout_s: float = 0.0) -> "TaskManager":
+    def from_checkpoint(
+        cls,
+        content: str,
+        task_timeout_s: float = 0.0,
+        max_task_retries: int = 3,
+    ) -> "TaskManager":
         state = json.loads(content)
         manager = cls(
             training_shards=None,
             evaluation_shards=state.get("evaluation_shards") or {},
+            prediction_shards=state.get("prediction_shards") or {},
             records_per_task=state["records_per_task"],
             num_epochs=state["num_epochs"],
             task_timeout_s=task_timeout_s,
+            max_task_retries=max_task_retries,
         )
         manager._training_shards = state.get("training_shards") or {}
         manager._epoch = state["epoch"]
         manager._finished_record_count = state.get("finished_record_count", 0)
         manager._todo.extend(_Task.from_json(t) for t in state["todo"])
         return manager
+
+
+class TaskProgressPersister:
+    """Periodically snapshots a TaskManager to disk so a restarted master
+    resumes the epoch instead of replaying it (reference: PS-mode masters
+    persist shard progress — SURVEY.md §5 checkpoint/resume).
+
+    Writes are atomic (tmp + rename); the cadence bounds the replay window
+    — tasks finished after the last snapshot simply re-run, which
+    at-least-once semantics already permit.
+    """
+
+    FILENAME = "task_progress.json"
+
+    def __init__(self, task_manager: TaskManager, checkpoint_dir: str,
+                 interval_s: float = 2.0):
+        import os
+
+        self._task_manager = task_manager
+        self._path = os.path.join(checkpoint_dir, self.FILENAME)
+        self._interval_s = interval_s
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    @classmethod
+    def progress_path(cls, checkpoint_dir: str) -> str:
+        import os
+
+        return os.path.join(checkpoint_dir, cls.FILENAME)
+
+    def start(self) -> "TaskProgressPersister":
+        self._thread = threading.Thread(
+            target=self._loop, name="task-progress-persister", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.persist_now()
+
+    def persist_now(self):
+        import os
+        import tempfile
+
+        content = self._task_manager.to_checkpoint()
+        directory = os.path.dirname(self._path)
+        fd, tmp_path = tempfile.mkstemp(
+            prefix=self.FILENAME + ".", dir=directory
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(content)
+            os.replace(tmp_path, self._path)
+        except BaseException:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            raise
+
+    def _loop(self):
+        while not self._stop_event.wait(self._interval_s):
+            try:
+                self.persist_now()
+            except Exception:
+                logger.exception("Task-progress persist failed; will retry")

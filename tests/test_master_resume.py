@@ -1,0 +1,109 @@
+"""Master restart resume: a killed master's replacement continues the
+epoch from the persisted shard-progress snapshot (reference: PS-mode
+masters persist shard progress — SURVEY.md §5 checkpoint/resume).
+"""
+
+import os
+
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.master.main import start_master
+from elasticdl_tpu.master.task_manager import (
+    TaskManager,
+    TaskProgressPersister,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.master_client import MasterClient
+
+
+def _job_args(tmp_path, n_records=512, records_per_task=64):
+    return parse_master_args([
+        "--model_zoo=model_zoo",
+        "--model_def=mnist.mnist_functional_api",
+        f"--training_data=synthetic://mnist?n={n_records}",
+        f"--records_per_task={records_per_task}",
+        f"--checkpoint_dir={tmp_path / 'ckpt'}",
+        "--num_epochs=2",
+        "--distribution_strategy=AllreduceStrategy",
+    ])
+
+
+def _drain(client, trained, stop_after=None):
+    """Pull and complete tasks, recording trained ranges; optionally stop
+    after N tasks (leaving the job unfinished)."""
+    done = 0
+    while True:
+        task = client.get_task()
+        if task.task_id == -1 and task.type != pb.WAIT:
+            return done
+        if task.type == pb.WAIT:
+            continue
+        if task.type == pb.TRAINING:
+            trained.append((task.epoch, task.start, task.end))
+        client.report_task_result(task.task_id, "")
+        done += 1
+        if stop_after is not None and done >= stop_after:
+            return done
+
+
+def test_master_killed_midepoch_resumes(tmp_path):
+    n_records, rpt = 512, 64
+    args = _job_args(tmp_path, n_records, rpt)
+    trained = []
+
+    # First master: train ~half of epoch 0, snapshot, then die without a
+    # clean shutdown (server only; the final persist never runs).
+    master = start_master(args)
+    client = MasterClient(master.addr, worker_id=0)
+    _drain(client, trained, stop_after=5)
+    master.progress_persister.persist_now()
+    client.close()
+    master.server.stop(grace=None)  # hard kill: no persister.stop()
+
+    progress_path = TaskProgressPersister.progress_path(args.checkpoint_dir)
+    assert os.path.exists(progress_path)
+
+    # Replacement master resumes from the snapshot mid-epoch.
+    master2 = start_master(_job_args(tmp_path, n_records, rpt))
+    assert master2.task_manager.finished_record_count == 5 * rpt
+    assert master2.task_manager.counts()["epoch"] == 0
+    client2 = MasterClient(master2.addr, worker_id=1)
+    _drain(client2, trained)
+    assert master2.task_manager.finished()
+
+    # Every record of both epochs trained at least once.
+    for epoch in (0, 1):
+        covered = set()
+        for ep, start, end in trained:
+            if ep == epoch:
+                covered.update(range(start, end))
+        assert covered == set(range(n_records)), f"gap in epoch {epoch}"
+    client2.close()
+    master2.stop()
+
+
+def test_finished_job_snapshot_resumes_as_finished(tmp_path):
+    manager = TaskManager(training_shards={"s": 128}, records_per_task=64)
+    task_ids = []
+    while True:
+        task = manager.get(0)
+        if task.task_id == -1:
+            break
+        task_ids.append(task.task_id)
+    for tid in task_ids:
+        manager.report(tid, True)
+    restored = TaskManager.from_checkpoint(manager.to_checkpoint())
+    assert restored.finished_record_count == 128
+    assert restored.counts()["todo"] == 0
+
+
+def test_corrupt_progress_snapshot_starts_fresh(tmp_path):
+    args = _job_args(tmp_path)
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    with open(TaskProgressPersister.progress_path(args.checkpoint_dir), "w") as f:
+        f.write("{not json")
+    master = start_master(args)
+    try:
+        assert master.task_manager.counts()["todo"] == 512 // 64
+        assert master.task_manager.finished_record_count == 0
+    finally:
+        master.stop()
