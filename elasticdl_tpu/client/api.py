@@ -37,6 +37,12 @@ def predict(argv):
 
 
 def _run_job(args, mode: str):
+    if args.image_name and args.distribution_strategy != DistributionStrategy.LOCAL:
+        # Cluster submission: `--image_name` means "run on Kubernetes" —
+        # create the master pod and return (reference client behavior).
+        from elasticdl_tpu.client.submit import submit_job
+
+        return submit_job(args, mode)
     if args.distribution_strategy == DistributionStrategy.LOCAL:
         return _run_local(args, mode)
     if args.distribution_strategy == DistributionStrategy.ALLREDUCE:

@@ -1,0 +1,81 @@
+"""Cluster job submission: render and create the master pod.
+
+Parity: elasticdl_client/common/k8s_client.py + api.py in the reference —
+`elasticdl train --image_name=...` submits a master pod to the cluster;
+the master pod then creates and supervises the worker pods
+(master/k8s_pod_manager.py).  The client's job ends at submission.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.common.args import args_to_argv
+from elasticdl_tpu.common.constants import JobType, Mode
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.master.k8s_client import (
+    K8sClient,
+    K8sConfig,
+    render_pod,
+)
+
+logger = get_logger("client.submit")
+
+# Client-side / derived flags that must not round-trip into the master pod
+# command line.
+_NO_FORWARD = {
+    "master_addr",  # the master *is* the addressee
+    "image_name",  # becomes the pod image (also forwarded: workers need it)
+    "job_type",  # derived from mode below
+}
+
+
+def job_type_for(args, mode: str) -> str:
+    if mode == Mode.EVALUATION:
+        return JobType.EVALUATION_ONLY
+    if mode == Mode.PREDICTION:
+        return JobType.PREDICTION_ONLY
+    return (
+        JobType.TRAINING_WITH_EVALUATION
+        if getattr(args, "validation_data", "")
+        else JobType.TRAINING_ONLY
+    )
+
+
+def render_master_pod(args, mode: str) -> dict:
+    from elasticdl_tpu.master.job_runner import _parse_resources
+
+    keys = {k for k in vars(args) if k not in _NO_FORWARD}
+    command = [
+        "python",
+        "-m",
+        "elasticdl_tpu.master.main",
+        f"--job_type={job_type_for(args, mode)}",
+        f"--image_name={args.image_name}",
+        *args_to_argv(args, keys=keys),
+    ]
+    return render_pod(
+        job_name=args.job_name,
+        replica_type="master",
+        index=0,
+        image=args.image_name,
+        command=command,
+        namespace=args.namespace,
+        resources=_parse_resources(args.master_resource_request) or None,
+        priority_class=args.worker_pod_priority,
+        volume_spec=args.volume,
+    )
+
+
+def submit_job(args, mode: str, k8s_client: K8sClient = None) -> int:
+    """Create the master pod and return; the cluster runs the job."""
+    client = k8s_client or K8sClient(K8sConfig.resolve(args.namespace))
+    manifest = render_master_pod(args, mode)
+    created = client.create_pod(manifest)
+    name = created["metadata"]["name"]
+    logger.info(
+        "Submitted job %s: master pod %s in namespace %s",
+        args.job_name,
+        name,
+        client.namespace,
+    )
+    print(f"Job {args.job_name} submitted (master pod {name})")
+    return 0

@@ -116,6 +116,21 @@ def add_cluster_arguments(parser: argparse.ArgumentParser):
         "--devices_per_worker", type=pos_int, default=1,
         help="TPU chips visible to each worker host (mesh = workers x devices)",
     )
+    parser.add_argument(
+        "--master_resource_request", default="",
+        help='k8s resources for the master pod, e.g. "cpu=1,memory=2Gi"',
+    )
+    parser.add_argument(
+        "--worker_resource_request", default="",
+        help='k8s resources per worker pod, e.g. "cpu=4,memory=8Gi,google.com/tpu=1"',
+    )
+    parser.add_argument(
+        "--volume", default="",
+        help="k8s volumes mounted into every job pod, e.g. "
+        '"claim_name=ckpt-pvc,mount_path=/ckpt" or '
+        '"host_path=/mnt/nfs,mount_path=/data"; separate multiple with ";". '
+        "Elastic training needs --checkpoint_dir on such a shared mount.",
+    )
 
 
 def build_master_parser() -> argparse.ArgumentParser:

@@ -10,7 +10,9 @@ pod manager plugs into the same flow.
 from __future__ import annotations
 
 import os
+import socket
 import tempfile
+import time
 
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.common.log_utils import get_logger
@@ -24,6 +26,130 @@ from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
 logger = get_logger("master.job_runner")
 
 
+def _capacity_oracle_from_env():
+    """Elastic scale-up signal for the subprocess substrate: the file named
+    by $ELASTICDL_CAPACITY_FILE holds an integer count of free worker slots
+    (ops/tests write it when capacity returns).  Absent env -> no scale-up."""
+    path = os.environ.get("ELASTICDL_CAPACITY_FILE", "")
+    if not path:
+        return None
+
+    def check(needed: int) -> int:
+        try:
+            with open(path) as f:
+                slots = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+        return max(0, min(needed, slots))
+
+    return check
+
+
+class _K8sCapacityProbe:
+    """Scale-up oracle on Kubernetes: capacity is unknowable without a
+    scheduler dry-run, so probe optimistically — grant a regrow attempt at
+    most every `cooldown_s`; a cluster still out of capacity leaves the new
+    pods Pending until the pod manager's startup timeout reads it as churn.
+    An $ELASTICDL_CAPACITY_FILE override wins when present (explicit ops
+    signal, no probing)."""
+
+    def __init__(self, cooldown_s: float = 300.0):
+        self._base_cooldown_s = cooldown_s
+        self._cooldown_s = cooldown_s
+        self._last_probe = time.time()
+
+    def __call__(self, needed: int) -> int:
+        explicit = _capacity_oracle_from_env()
+        if explicit is not None:
+            return explicit(needed)
+        now = time.time()
+        if now - self._last_probe < self._cooldown_s:
+            return 0
+        self._last_probe = now
+        return needed
+
+    def failed(self):
+        """Probe pods never scheduled: exponential backoff (cap 1h)."""
+        self._cooldown_s = min(self._cooldown_s * 2, 3600.0)
+
+    def succeeded(self):
+        self._cooldown_s = self._base_cooldown_s
+
+
+def _parse_resources(spec: str) -> dict:
+    """'cpu=1,memory=2Gi' -> {'cpu': '1', 'memory': '2Gi'} (k8s quantities
+    stay strings; the API server owns their grammar)."""
+    out = {}
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        if "=" not in item:
+            raise ValueError(f"Malformed resource {item!r} in {spec!r}")
+        key, value = item.split("=", 1)
+        out[key.strip()] = value.strip()
+    return out
+
+
+def _running_on_k8s(args) -> bool:
+    return bool(args.image_name) and bool(
+        os.environ.get("KUBERNETES_SERVICE_HOST")
+        or os.environ.get("ELASTICDL_K8S_HOST")
+    )
+
+
+def _build_worker_manager(args, master, rendezvous, worker_env):
+    """Substrate selection: worker pods when this master runs on Kubernetes
+    (reference: the master pod creates worker pods through the API server),
+    local subprocesses otherwise."""
+    common = dict(
+        num_workers=args.num_workers,
+        rendezvous=rendezvous,
+        task_manager=master.task_manager,
+        max_restarts=args.max_worker_restarts,
+        job_finished_fn=master.task_manager.finished,
+        liveness_timeout_s=args.worker_liveness_timeout_s,
+    )
+    if _running_on_k8s(args):
+        from elasticdl_tpu.master.k8s_client import K8sClient, K8sConfig
+        from elasticdl_tpu.master.k8s_pod_manager import KubernetesPodManager
+
+        client = K8sClient(K8sConfig.resolve(args.namespace))
+        pod_ip = os.environ.get("MY_POD_IP", "") or socket.gethostbyname(
+            socket.gethostname()
+        )
+        master_addr = f"{pod_ip}:{master.port}"
+        owner = None
+        own_name = os.environ.get("HOSTNAME", "")
+        if own_name:
+            owner = client.get_pod(own_name)
+        return KubernetesPodManager(
+            worker_argv_fn=worker_argv_from_args(args, master_addr),
+            k8s_client=client,
+            job_name=args.job_name,
+            image=args.image_name,
+            worker_env=worker_env,
+            worker_resources=_parse_resources(args.worker_resource_request)
+            or None,
+            priority_class=args.worker_pod_priority,
+            owner_pod=owner,
+            volume_spec=args.volume,
+            scale_up_check_fn=(
+                _K8sCapacityProbe() if args.need_elasticity else None
+            ),
+            **common,
+        )
+    return LocalProcessManager(
+        worker_argv_fn=worker_argv_from_args(args, master.addr),
+        worker_env=worker_env,
+        log_dir=os.path.join(
+            args.checkpoint_dir or tempfile.gettempdir(),
+            f"{args.job_name}_worker_logs",
+        ),
+        scale_up_check_fn=(
+            _capacity_oracle_from_env() if args.need_elasticity else None
+        ),
+        **common,
+    )
+
+
 def _ensure_elastic_checkpointing(args, mode: str):
     """Churn recovery is restart-the-world + restore-latest: without a
     checkpoint, a re-formed world re-initializes weights while the
@@ -35,6 +161,19 @@ def _ensure_elastic_checkpointing(args, mode: str):
     if mode != Mode.TRAINING or not args.need_elasticity:
         return
     if not args.checkpoint_dir:
+        if _running_on_k8s(args):
+            # A master-pod-local temp dir is invisible to worker pods:
+            # workers would checkpoint into their own filesystems and a
+            # re-formed world would restore nothing — exactly the silent
+            # weight reset this guard exists to prevent.  Shared storage
+            # is the operator's to provide; refuse rather than pretend.
+            raise ValueError(
+                "Elastic training on Kubernetes requires --checkpoint_dir "
+                "on storage every pod shares — mount it with --volume "
+                '(e.g. --volume "claim_name=ckpt-pvc,mount_path=/ckpt" '
+                "--checkpoint_dir /ckpt/myjob); without it, worker churn "
+                "silently resets model weights."
+            )
         args.checkpoint_dir = tempfile.mkdtemp(
             prefix=f"{args.job_name}_ckpt_"
         )
@@ -75,21 +214,10 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
         if "=" in pair:
             key, value = pair.split("=", 1)
             worker_env[key.strip()] = value
-    manager = LocalProcessManager(
-        num_workers=args.num_workers,
-        worker_argv_fn=worker_argv_from_args(args, master.addr),
-        rendezvous=rendezvous,
-        task_manager=master.task_manager,
-        max_restarts=args.max_worker_restarts,
-        worker_env=worker_env,
-        log_dir=os.path.join(
-            args.checkpoint_dir or tempfile.gettempdir(),
-            f"{args.job_name}_worker_logs",
-        ),
-        job_finished_fn=master.task_manager.finished,
-        liveness_timeout_s=args.worker_liveness_timeout_s,
-    )
+    manager = _build_worker_manager(args, master, rendezvous, worker_env)
     master.pod_manager = manager  # type: ignore[attr-defined]
+    progress_persister = master.progress_persister
+    job_succeeded = False
     try:
         manager.start()
         ok = manager.wait()
@@ -105,10 +233,15 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
             logger.error("Workers exited but tasks remain unfinished")
             return 1
         logger.info("AllReduce job complete")
+        job_succeeded = True
         return 0
     finally:
         manager.stop()
         master.stop()
+        if job_succeeded and progress_persister is not None:
+            # Leaving a terminal snapshot behind would turn the next run
+            # with this checkpoint_dir into a silent no-op.
+            progress_persister.clear()
 
 
 def run_ps_job(args, mode: str = Mode.TRAINING) -> int:

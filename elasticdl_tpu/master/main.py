@@ -175,15 +175,41 @@ def start_master(args, model_spec=None, rendezvous_server=None) -> Master:
     return master
 
 
+def mode_from_job_type(job_type: str) -> str:
+    from elasticdl_tpu.common.constants import JobType, Mode
+
+    return {
+        JobType.TRAINING_ONLY: Mode.TRAINING,
+        JobType.TRAINING_WITH_EVALUATION: Mode.TRAINING,
+        JobType.EVALUATION_ONLY: Mode.EVALUATION,
+        JobType.PREDICTION_ONLY: Mode.PREDICTION,
+    }[job_type]
+
+
 def main(argv=None):
+    """`python -m elasticdl_tpu.master.main` — the master pod's command.
+
+    Cluster strategies run the full job (control-plane services + worker
+    fleet supervision, reference master-pod behavior); Local starts a bare
+    master server for debugging.
+    """
     args = parse_master_args(argv)
+    if args.distribution_strategy != DistributionStrategy.LOCAL:
+        from elasticdl_tpu.master.job_runner import run_allreduce_job, run_ps_job
+
+        runner = (
+            run_ps_job
+            if args.distribution_strategy
+            == DistributionStrategy.PARAMETER_SERVER
+            else run_allreduce_job
+        )
+        return runner(args, mode_from_job_type(args.job_type))
     master = start_master(args)
     logger.info("Master running on port %d", master.port)
-    if args.distribution_strategy == DistributionStrategy.LOCAL:
-        logger.warning(
-            "Master started standalone in Local mode; use `elasticdl train` "
-            "to run master+worker together."
-        )
+    logger.warning(
+        "Master started standalone in Local mode; use `elasticdl train` "
+        "to run master+worker together."
+    )
     master.server.wait_for_termination()
 
 

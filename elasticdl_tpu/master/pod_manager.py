@@ -1,4 +1,4 @@
-"""Elastic worker-process management.
+"""Elastic worker-fleet management.
 
 Parity: elasticdl/python/master/pod_manager.py (older
 k8s_instance_manager.py) in the reference — create worker pods, watch
@@ -15,9 +15,12 @@ workers, which restore model state from the latest checkpoint.  Data
 progress lives in the master's TaskManager, which survives — at-least-once
 semantics mean no records are lost across re-formations.
 
-`LocalProcessManager` is the subprocess-based substrate (local mode, tests,
-single-host multi-process); the Kubernetes pod manager implements the same
-`start/stop/scale` surface over pod events (see master/k8s_client.py).
+That supervision policy is substrate-independent, so it lives in
+`ElasticWorkerManager`; substrates plug in via five hooks (launch, poll,
+terminate, kill, describe).  `LocalProcessManager` runs workers as
+subprocesses (local mode, tests, single-host multi-process);
+`KubernetesPodManager` (master/k8s_pod_manager.py) runs them as pods over
+the same surface.
 """
 
 from __future__ import annotations
@@ -34,20 +37,22 @@ from elasticdl_tpu.common.log_utils import get_logger
 logger = get_logger("master.pod_manager")
 
 
-class WorkerProcess:
-    def __init__(self, worker_id: int, popen: subprocess.Popen, log_path: str):
-        self.worker_id = worker_id
-        self.popen = popen
-        self.log_path = log_path
-
-
-class LocalProcessManager:
-    """Supervises worker subprocesses with elastic restart-the-world.
+class ElasticWorkerManager:
+    """Substrate-agnostic elastic supervision (restart-the-world policy).
 
     `worker_argv_fn(worker_id)` builds the worker command line;
     `on_world_change(worker_ids)` is told every new world before launch
     (wired to ElasticRendezvous.set_worker_hosts and
     TaskManager.recover_tasks by the caller).
+
+    Subclasses implement:
+      _substrate_start()                — one-time setup before first world
+      _substrate_launch(worker_ids)    — start workers, return handles
+                                         (objects with .worker_id)
+      _substrate_poll(handle)          — None while alive, else exit code
+      _substrate_terminate(handles)    — tear workers down, blocking
+      _substrate_kill(handle, sig)     — hard-kill one worker
+      _worker_host(worker_id)          — address advertised to rendezvous
     """
 
     def __init__(
@@ -57,20 +62,18 @@ class LocalProcessManager:
         rendezvous=None,
         task_manager=None,
         max_restarts: int = 3,
-        worker_env: Optional[Dict[str, str]] = None,
-        log_dir: str = "",
         job_finished_fn: Optional[Callable[[], bool]] = None,
         poll_interval_s: float = 0.2,
         liveness_timeout_s: float = 0.0,
         startup_grace_s: Optional[float] = None,
+        target_num_workers: Optional[int] = None,
+        scale_up_check_fn: Optional[Callable[[int], int]] = None,
     ):
         self._num_workers = num_workers
         self._worker_argv_fn = worker_argv_fn
         self._rendezvous = rendezvous
         self._task_manager = task_manager
         self._max_restarts = max_restarts
-        self._worker_env = dict(worker_env or {})
-        self._log_dir = log_dir
         self._job_finished_fn = job_finished_fn
         self._poll_interval_s = poll_interval_s
         self._liveness_timeout_s = liveness_timeout_s
@@ -81,9 +84,15 @@ class LocalProcessManager:
             if startup_grace_s is not None
             else 4 * liveness_timeout_s
         )
+        # Elastic scale-up: the world may shrink under churn; when capacity
+        # returns (scale_up_check_fn says so), grow back toward the target.
+        self._target_num_workers = (
+            target_num_workers if target_num_workers is not None else num_workers
+        )
+        self._scale_up_check_fn = scale_up_check_fn
 
         self._lock = threading.Lock()
-        self._procs: List[WorkerProcess] = []
+        self._handles: List = []
         self._next_worker_id = 0
         self._restarts_used = 0
         self._stopped = False
@@ -92,12 +101,36 @@ class LocalProcessManager:
         self._monitor_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
+    # Substrate hooks
+    # ------------------------------------------------------------------
+
+    def _substrate_start(self):
+        pass
+
+    def _substrate_launch(self, worker_ids: List[int]) -> List:
+        raise NotImplementedError
+
+    def _substrate_poll(self, handle) -> Optional[int]:
+        raise NotImplementedError
+
+    def _substrate_terminate(self, handles: List):
+        raise NotImplementedError
+
+    def _substrate_kill(self, handle, sig: int = 9):
+        raise NotImplementedError
+
+    def _worker_host(self, worker_id: int) -> str:
+        return "127.0.0.1"
+
+    def _describe(self, handle) -> str:
+        return f"worker {handle.worker_id}"
+
+    # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
 
     def start(self):
-        if self._log_dir:
-            os.makedirs(self._log_dir, exist_ok=True)
+        self._substrate_start()
         self._launch_world(self._num_workers)
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, name="pod-manager-monitor", daemon=True
@@ -117,23 +150,20 @@ class LocalProcessManager:
     def stop(self):
         with self._lock:
             self._stopped = True
-            procs = list(self._procs)
-        self._terminate_procs(procs)
+            handles = list(self._handles)
+        self._substrate_terminate(handles)
         self._done_event.set()
 
     def current_worker_ids(self) -> List[int]:
         with self._lock:
-            return [wp.worker_id for wp in self._procs]
+            return [h.worker_id for h in self._handles]
 
     def kill_worker(self, worker_id: int, sig: int = 9):
         """Fault injection / preemption simulation: kill one worker."""
         with self._lock:
-            for wp in self._procs:
-                if wp.worker_id == worker_id:
-                    try:
-                        wp.popen.send_signal(sig)
-                    except ProcessLookupError:
-                        pass
+            for h in self._handles:
+                if h.worker_id == worker_id:
+                    self._substrate_kill(h, sig)
                     return
         raise ValueError(f"No live worker {worker_id}")
 
@@ -142,12 +172,13 @@ class LocalProcessManager:
         with self._lock:
             if self._stopped:
                 return
-            procs = list(self._procs)
-            self._procs = []
+            handles = list(self._handles)
+            self._handles = []
         logger.info("Scaling world to %d workers", num_workers)
-        self._recover_world_tasks(procs)
-        self._terminate_procs(procs)
+        self._recover_world_tasks(handles)
+        self._substrate_terminate(handles)
         self._num_workers = num_workers
+        self._target_num_workers = max(self._target_num_workers, num_workers)
         self._launch_world(num_workers)
 
     # ------------------------------------------------------------------
@@ -162,53 +193,23 @@ class LocalProcessManager:
             self._next_worker_id += n
         if self._rendezvous is not None:
             self._rendezvous.set_worker_hosts(
-                [(wid, "127.0.0.1") for wid in worker_ids]
+                [(wid, self._worker_host(wid)) for wid in worker_ids]
             )
-        procs = []
-        for wid in worker_ids:
-            argv = self._worker_argv_fn(wid)
-            log_path = (
-                os.path.join(self._log_dir, f"worker_{wid}.log")
-                if self._log_dir
-                else os.devnull
-            )
-            log_file = open(log_path, "wb")
-            env = {**os.environ, **self._worker_env}
-            popen = subprocess.Popen(
-                argv, stdout=log_file, stderr=subprocess.STDOUT, env=env
-            )
-            log_file.close()
-            procs.append(WorkerProcess(wid, popen, log_path))
-            logger.info("Launched worker %d (pid %d)", wid, popen.pid)
+        handles = self._substrate_launch(worker_ids)
         with self._lock:
             if self._stopped:
-                # stop() raced the launch; don't leak the new processes.
-                stale = procs
-                procs = []
+                # stop() raced the launch; don't leak the new workers.
+                stale = handles
+                handles = []
             else:
-                self._procs = procs
+                self._handles = handles
                 stale = []
-        self._terminate_procs(stale)
+        self._substrate_terminate(stale)
 
-    def _terminate_procs(self, procs: List[WorkerProcess]):
-        for wp in procs:
-            if wp.popen.poll() is None:
-                try:
-                    wp.popen.terminate()
-                except ProcessLookupError:
-                    pass
-        deadline = time.time() + 5
-        for wp in procs:
-            try:
-                wp.popen.wait(timeout=max(0.1, deadline - time.time()))
-            except subprocess.TimeoutExpired:
-                wp.popen.kill()
-                wp.popen.wait()
-
-    def _recover_world_tasks(self, procs: List[WorkerProcess]):
+    def _recover_world_tasks(self, handles: List):
         if self._task_manager is not None:
-            for wp in procs:
-                self._task_manager.recover_tasks(wp.worker_id)
+            for h in handles:
+                self._task_manager.recover_tasks(h.worker_id)
 
     def _job_finished(self) -> bool:
         return bool(self._job_finished_fn and self._job_finished_fn())
@@ -221,8 +222,8 @@ class LocalProcessManager:
             self._failed_reason = f"pod-manager monitor crashed: {exc}"
             with self._lock:
                 self._stopped = True
-                procs = list(self._procs)
-            self._terminate_procs(procs)
+                handles = list(self._handles)
+            self._substrate_terminate(handles)
             self._done_event.set()
 
     def _monitor_loop_inner(self):
@@ -231,28 +232,29 @@ class LocalProcessManager:
             with self._lock:
                 if self._stopped:
                     return
-                procs = list(self._procs)
-            self._kill_stale_workers(procs)
-            exited = [(wp, wp.popen.poll()) for wp in procs]
-            exited = [(wp, code) for wp, code in exited if code is not None]
+                handles = list(self._handles)
+            self._kill_stale_workers(handles)
+            polled = [(h, self._substrate_poll(h)) for h in handles]
+            exited = [(h, code) for h, code in polled if code is not None]
             if not exited:
+                self._maybe_scale_up(handles)
                 continue
-            crashed = [(wp, code) for wp, code in exited if code != 0]
+            crashed = [(h, code) for h, code in exited if code != 0]
             if crashed and not self._job_finished():
-                self._handle_churn(procs, crashed)
+                self._handle_churn(handles, crashed)
                 with self._lock:
-                    if self._stopped or not self._procs:
+                    if self._stopped or not self._handles:
                         return
                 continue
-            if all(wp.popen.poll() is not None for wp in procs):
+            if all(code is not None for _, code in polled):
                 # Whole fleet exited cleanly (or job already done): finished.
                 logger.info("All workers exited; job done")
                 self._done_event.set()
                 return
 
-    def _kill_stale_workers(self, procs: List[WorkerProcess]):
+    def _kill_stale_workers(self, handles: List):
         """Hung-worker detection: a worker whose heartbeat went silent is
-        killed so the normal churn path re-forms the world (process exit is
+        killed so the normal churn path re-forms the world (worker exit is
         the only signal the monitor reacts to; this converts 'wedged but
         alive' into it)."""
         if (
@@ -266,34 +268,60 @@ class LocalProcessManager:
                 self._liveness_timeout_s, self._startup_grace_s
             )
         )
-        for wp in procs:
-            if wp.worker_id in stale and wp.popen.poll() is None:
+        for h in handles:
+            if h.worker_id in stale and self._substrate_poll(h) is None:
                 logger.warning(
                     "Worker %d heartbeat stale > %.0fs; killing it",
-                    wp.worker_id,
+                    h.worker_id,
                     self._liveness_timeout_s,
                 )
-                try:
-                    wp.popen.kill()
-                except ProcessLookupError:
-                    pass
+                self._substrate_kill(h, 9)
 
-    def _handle_churn(self, procs: List[WorkerProcess], crashed):
+    def _maybe_scale_up(self, handles: List) -> bool:
+        """Elastic rejoin: if the world shrank under churn and capacity has
+        returned, re-form at a larger size (reference behavior: scavenge
+        freed resources back up to the requested worker count, SURVEY §6).
+        Growth is still restart-the-world — workers restore from the latest
+        checkpoint and the TaskManager replays in-flight work."""
+        current = len(handles)
+        if current >= self._target_num_workers or self._scale_up_check_fn is None:
+            return False
+        if self._job_finished():
+            return False
+        grant = self._scale_up_check_fn(self._target_num_workers - current)
+        if grant <= 0:
+            return False
+        new_size = min(self._target_num_workers, current + grant)
+        logger.info(
+            "Capacity returned: growing world %d -> %d workers",
+            current,
+            new_size,
+        )
+        with self._lock:
+            if self._stopped:
+                return True
+            self._handles = []
+        self._recover_world_tasks(handles)
+        self._substrate_terminate(handles)
+        self._num_workers = new_size
+        self._launch_world(new_size)
+        return True
+
+    def _handle_churn(self, handles: List, crashed):
         """One churn event: any worker death invalidates the whole world."""
-        for wp, code in crashed:
+        for h, code in crashed:
             logger.warning(
-                "Worker %d died (exit %s) — world re-formation (log: %s)",
-                wp.worker_id,
+                "%s died (exit %s) — world re-formation",
+                self._describe(h),
                 code,
-                wp.log_path,
             )
         with self._lock:
-            self._procs = []
+            self._handles = []
             self._restarts_used += 1
             budget_left = self._restarts_used <= self._max_restarts
-            old_size = len(procs)
-        self._recover_world_tasks(procs)
-        self._terminate_procs(procs)  # survivors die with the world
+            old_size = len(handles)
+        self._recover_world_tasks(handles)
+        self._substrate_terminate(handles)  # survivors die with the world
         new_size = old_size if budget_left else old_size - 1
         if new_size < 1:
             self._failed_reason = (
@@ -313,6 +341,79 @@ class LocalProcessManager:
             self._max_restarts,
         )
         self._launch_world(new_size)
+
+
+class WorkerProcess:
+    def __init__(self, worker_id: int, popen: subprocess.Popen, log_path: str):
+        self.worker_id = worker_id
+        self.popen = popen
+        self.log_path = log_path
+
+
+class LocalProcessManager(ElasticWorkerManager):
+    """Subprocess substrate: workers are local child processes."""
+
+    def __init__(
+        self,
+        num_workers: int,
+        worker_argv_fn: Callable[[int], List[str]],
+        worker_env: Optional[Dict[str, str]] = None,
+        log_dir: str = "",
+        **kwargs,
+    ):
+        super().__init__(num_workers, worker_argv_fn, **kwargs)
+        self._worker_env = dict(worker_env or {})
+        self._log_dir = log_dir
+
+    def _substrate_start(self):
+        if self._log_dir:
+            os.makedirs(self._log_dir, exist_ok=True)
+
+    def _substrate_launch(self, worker_ids: List[int]) -> List[WorkerProcess]:
+        procs = []
+        for wid in worker_ids:
+            argv = self._worker_argv_fn(wid)
+            log_path = (
+                os.path.join(self._log_dir, f"worker_{wid}.log")
+                if self._log_dir
+                else os.devnull
+            )
+            log_file = open(log_path, "wb")
+            env = {**os.environ, **self._worker_env}
+            popen = subprocess.Popen(
+                argv, stdout=log_file, stderr=subprocess.STDOUT, env=env
+            )
+            log_file.close()
+            procs.append(WorkerProcess(wid, popen, log_path))
+            logger.info("Launched worker %d (pid %d)", wid, popen.pid)
+        return procs
+
+    def _substrate_poll(self, handle: WorkerProcess) -> Optional[int]:
+        return handle.popen.poll()
+
+    def _substrate_terminate(self, handles: List[WorkerProcess]):
+        for wp in handles:
+            if wp.popen.poll() is None:
+                try:
+                    wp.popen.terminate()
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + 5
+        for wp in handles:
+            try:
+                wp.popen.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                wp.popen.kill()
+                wp.popen.wait()
+
+    def _substrate_kill(self, handle: WorkerProcess, sig: int = 9):
+        try:
+            handle.popen.send_signal(sig)
+        except ProcessLookupError:
+            pass
+
+    def _describe(self, handle: WorkerProcess) -> str:
+        return f"Worker {handle.worker_id} (log: {handle.log_path})"
 
 
 def worker_argv_from_args(args, master_addr: str) -> Callable[[int], List[str]]:

@@ -11,10 +11,19 @@ port).  Workers join the world by calling `jax.distributed.initialize`
 with their assigned (rank, world_size, coordinator); the coordination
 service itself then barriers until everyone arrives.  A new world gets a
 fresh coordinator port so stale members of the old world can never join.
+
+Deferred host resolution (Kubernetes): pod IPs are unknown until the
+kubelet schedules the pod, so the pod manager may declare a world with
+empty hosts.  Each worker advertises its own address on every liveness
+report and rank poll; `coordinator_addr` stays empty until rank 0's host
+is known (workers keep polling), and the coordinator port for such remote
+worlds is chosen deterministically from the rendezvous id — the master
+cannot bind-probe a port inside another pod's network namespace.
 """
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
@@ -30,6 +39,15 @@ def find_free_port(host: str = "127.0.0.1") -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
         sock.bind((host, 0))
         return sock.getsockname()[1]
+
+
+def remote_coordinator_port(rendezvous_id: int) -> int:
+    """Coordinator port on a remote rank-0 host.  Deterministic but varied
+    with the rendezvous id so a straggler of world N can never connect to
+    world N+1's coordinator; rank 0 binds it inside its own pod where the
+    ephemeral range is otherwise empty."""
+    base = int(os.environ.get("ELASTICDL_COORDINATOR_PORT", "3391"))
+    return base + rendezvous_id % 1021
 
 
 class ElasticRendezvous:
@@ -53,18 +71,20 @@ class ElasticRendezvous:
         """Declare the new world: [(worker_id, host)]. Returns rendezvous_id.
 
         Ranks are assigned by ascending worker_id; rank 0's host gets the
-        coordinator on a fresh port.
+        coordinator on a fresh port.  A host may be "" (not yet scheduled,
+        Kubernetes): the coordinator address is then resolved lazily once
+        rank 0 advertises its address (see _resolve_coordinator_locked).
         """
         with self._lock:
             workers = sorted(workers)
             self._workers = workers
             self._rendezvous_id += 1
-            if workers:
+            if workers and workers[0][1]:
                 rank0_host = workers[0][1]
                 port = self._coordinator_port_fn(rank0_host)
                 self._coordinator_addr = f"{rank0_host}:{port}"
             else:
-                self._coordinator_addr = ""
+                self._coordinator_addr = ""  # deferred (or empty world)
             # None until the worker's FIRST heartbeat: staleness for
             # never-heartbeated workers is judged against the (longer)
             # startup grace, since world formation (spawn + imports +
@@ -110,8 +130,47 @@ class ElasticRendezvous:
     # Worker-facing (via servicer)
     # ------------------------------------------------------------------
 
-    def get_comm_rank(self, worker_id: int) -> pb.GetCommRankResponse:
+    def _record_host_locked(self, worker_id: int, host: str):
+        """Fill in a worker's advertised address (deferred-host worlds)."""
+        if not host:
+            return
+        for i, (wid, known) in enumerate(self._workers):
+            if wid == worker_id and known != host:
+                self._workers[i] = (wid, host)
+                logger.info(
+                    "Worker %d advertised host %s (rendezvous %d)",
+                    worker_id,
+                    host,
+                    self._rendezvous_id,
+                )
+
+    def _resolve_coordinator_locked(self):
+        """Late coordinator resolution: once rank 0's host is known, pin the
+        coordinator to it on a deterministic per-world port (binding to
+        probe is impossible — the port lives in rank 0's netns, not ours)."""
+        if self._coordinator_addr or not self._workers:
+            return
+        rank0_host = self._workers[0][1]
+        if rank0_host:
+            self._coordinator_addr = (
+                f"{rank0_host}:{remote_coordinator_port(self._rendezvous_id)}"
+            )
+            logger.info(
+                "Rendezvous %d coordinator resolved: %s",
+                self._rendezvous_id,
+                self._coordinator_addr,
+            )
+
+    def get_comm_rank(
+        self, worker_id: int, host: str = ""
+    ) -> pb.GetCommRankResponse:
+        """`host` is the worker's advertised address (deferred-host worlds).
+        It rides the rank poll — NOT the liveness channel — so polling for
+        a rank never counts as a heartbeat and the startup grace for
+        never-heartbeated workers stays intact."""
         with self._lock:
+            self._record_host_locked(worker_id, host)
+            self._resolve_coordinator_locked()
             ids = [wid for wid, _ in self._workers]
             rank = ids.index(worker_id) if worker_id in ids else -1
             return pb.GetCommRankResponse(
@@ -123,9 +182,10 @@ class ElasticRendezvous:
             )
 
     def report_liveness(self, worker_id: int, host: str, rendezvous_id: int) -> bool:
-        """Heartbeat; returns True when the worker's world is stale (the
-        worker should re-rendezvous)."""
+        """Heartbeat (also the host-advertisement channel); returns True
+        when the worker's world is stale (the worker should re-rendezvous)."""
         with self._lock:
+            self._record_host_locked(worker_id, host)
             if worker_id in self._last_heartbeat:
                 self._last_heartbeat[worker_id] = time.time()
             return rendezvous_id != self._rendezvous_id

@@ -90,7 +90,9 @@ class MasterServicer(_Base):
     def get_comm_rank(self, request, context):
         if self._rendezvous_server is None:
             return pb.GetCommRankResponse(rank_id=0, world_size=1, rendezvous_id=0)
-        return self._rendezvous_server.get_comm_rank(request.worker_id)
+        return self._rendezvous_server.get_comm_rank(
+            request.worker_id, request.host
+        )
 
     def report_worker_liveness(self, request, context):
         should_reset = False

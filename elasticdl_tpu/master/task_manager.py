@@ -480,6 +480,19 @@ class TaskProgressPersister:
                 pass
             raise
 
+    def clear(self):
+        """Remove the snapshot.  Called after a job COMPLETES successfully:
+        a terminal snapshot left behind would make any re-run with the same
+        checkpoint_dir resume into an already-finished task queue and exit
+        'complete' having trained nothing."""
+        import os
+
+        try:
+            os.unlink(self._path)
+            logger.info("Cleared task-progress snapshot %s", self._path)
+        except FileNotFoundError:
+            pass
+
     def _loop(self):
         while not self._stop_event.wait(self._interval_s):
             try:

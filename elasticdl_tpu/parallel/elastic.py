@@ -43,23 +43,54 @@ class WorldInfo:
         return self.rank == 0
 
 
+def advertised_host() -> str:
+    """The address this worker tells the rendezvous to reach it at.
+    On Kubernetes the pod IP is injected as MY_POD_IP (k8s_client pod
+    rendering); ELASTICDL_WORKER_HOST overrides for bespoke networks;
+    single-host worlds fall back to loopback."""
+    import os
+
+    return (
+        os.environ.get("ELASTICDL_WORKER_HOST", "")
+        or os.environ.get("MY_POD_IP", "")
+        or "127.0.0.1"
+    )
+
+
 def join_world(
     master_client,
     poll_interval_s: float = 0.5,
     timeout_s: float = 300.0,
     initialization_timeout_s: int = 120,
 ) -> WorldInfo:
-    """Poll the master rendezvous until this worker has a rank, then join
-    the jax.distributed world (no-op for world_size == 1)."""
+    """Poll the master rendezvous until this worker has a rank AND the
+    coordinator is resolved, then join the jax.distributed world (no-op
+    for world_size == 1).
+
+    Each poll carries this worker's advertised host: in deferred-host
+    worlds (Kubernetes) the coordinator address can only resolve after
+    rank 0 has advertised, and advertising must repeat because a world
+    re-declaration discards previously reported hosts.  Advertising rides
+    the rank poll, never the liveness channel — a heartbeat during world
+    formation would collapse the rendezvous startup grace to the (much
+    shorter) steady-state liveness timeout and get healthy workers killed
+    while peers are still pulling images.
+    """
     deadline = time.time() + timeout_s
+    host = advertised_host()
     while True:
-        resp = master_client.get_comm_rank()
-        if resp.rank_id >= 0 and resp.world_size > 0:
+        resp = master_client.get_comm_rank(host)
+        if (
+            resp.rank_id >= 0
+            and resp.world_size > 0
+            and (resp.world_size == 1 or resp.coordinator_addr)
+        ):
             break
         if time.time() > deadline:
             raise TimeoutError(
                 f"Worker {master_client.worker_id} never received a rank "
-                f"(last world_size={resp.world_size})"
+                f"(last world_size={resp.world_size}, "
+                f"coordinator={resp.coordinator_addr!r})"
             )
         time.sleep(poll_interval_s)
     info = WorldInfo(
@@ -96,14 +127,14 @@ class HeartbeatReporter:
         self,
         master_client,
         world: WorldInfo,
-        host: str = "127.0.0.1",
+        host: str = "",
         interval_s: float = 5.0,
     ):
         import threading
 
         self._mc = master_client
         self._world = world
-        self._host = host
+        self._host = host or advertised_host()
         self._interval_s = interval_s
         self._stop = threading.Event()
         self._thread = threading.Thread(

@@ -63,9 +63,9 @@ class MasterClient:
             )
         )
 
-    def get_comm_rank(self) -> pb.GetCommRankResponse:
+    def get_comm_rank(self, host: str = "") -> pb.GetCommRankResponse:
         return self._stub.get_comm_rank(
-            pb.GetCommRankRequest(worker_id=self._worker_id)
+            pb.GetCommRankRequest(worker_id=self._worker_id, host=host)
         )
 
     def report_worker_liveness(self, host: str, rendezvous_id: int) -> bool:

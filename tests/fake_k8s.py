@@ -1,0 +1,245 @@
+"""In-process fake Kubernetes API server (pods-only) for tests.
+
+Speaks just enough of the real wire protocol for
+`elasticdl_tpu.master.k8s_client.K8sClient` to run unmodified against it:
+create/get/list/delete pods plus the JSON-lines watch stream (ADDED /
+MODIFIED / DELETED events, labelSelector filtering).  Tests drive pod
+lifecycle explicitly (`set_running`, `fail_pod`, `succeed_pod`) or enable
+`auto_run` to schedule every created pod immediately, and can toggle
+`schedulable=False` to simulate a capacity-starved cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlsplit
+
+
+def _match_selector(labels: Dict[str, str], selector: str) -> bool:
+    for clause in filter(None, selector.split(",")):
+        if "=" not in clause:
+            return False
+        k, v = clause.split("=", 1)
+        if labels.get(k.strip()) != v.strip():
+            return False
+    return True
+
+
+class FakeK8sApiServer:
+    def __init__(self, auto_run: bool = True):
+        self.auto_run = auto_run
+        self.schedulable = True
+        self._lock = threading.Lock()
+        self._pods: Dict[str, dict] = {}
+        self._rv = 0
+        self._watchers: List[queue.Queue] = []
+        self._uid = 0
+        self.create_log: List[str] = []
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def _send_json(self, obj, status=200):
+                payload = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                parts = urlsplit(self.path)
+                q = {k: v[0] for k, v in parse_qs(parts.query).items()}
+                segs = parts.path.strip("/").split("/")
+                # /api/v1/namespaces/{ns}/pods[/{name}]
+                if len(segs) == 6:
+                    pod = server.get_pod(segs[5])
+                    if pod is None:
+                        self._send_json(
+                            {"kind": "Status", "code": 404,
+                             "reason": "NotFound"}, 404)
+                    else:
+                        self._send_json(pod)
+                    return
+                selector = q.get("labelSelector", "")
+                if q.get("watch") == "true":
+                    self._watch(selector, float(q.get("timeoutSeconds", 30)))
+                    return
+                self._send_json(
+                    {
+                        "kind": "PodList",
+                        "metadata": {"resourceVersion": str(server._rv)},
+                        "items": server.list_pods(selector),
+                    }
+                )
+
+            def _watch(self, selector: str, timeout_s: float):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                events = queue.Queue()
+                # Like list-then-watch collapsed: current state first.
+                for pod in server.list_pods(selector):
+                    events.put({"type": "ADDED", "object": pod})
+                server._add_watcher(events)
+                deadline = time.time() + timeout_s
+                try:
+                    while time.time() < deadline:
+                        try:
+                            event = events.get(timeout=0.1)
+                        except queue.Empty:
+                            continue
+                        obj = event["object"]
+                        labels = obj.get("metadata", {}).get("labels", {})
+                        if selector and not _match_selector(labels, selector):
+                            continue
+                        self.wfile.write(
+                            (json.dumps(event) + "\n").encode()
+                        )
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    server._remove_watcher(events)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                manifest = json.loads(self.rfile.read(length))
+                created = server.create_pod(manifest)
+                self._send_json(created, 201)
+
+            def do_DELETE(self):
+                segs = urlsplit(self.path).path.strip("/").split("/")
+                name = segs[5]
+                if server.delete_pod(name):
+                    self._send_json({"kind": "Status", "status": "Success"})
+                else:
+                    self._send_json(
+                        {"kind": "Status", "code": 404, "reason": "NotFound"},
+                        404,
+                    )
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "FakeK8sApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def host(self) -> str:
+        return "http://127.0.0.1:%d" % self._httpd.server_address[1]
+
+    # -- pod store ------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _add_watcher(self, q: queue.Queue):
+        with self._lock:
+            self._watchers.append(q)
+
+    def _remove_watcher(self, q: queue.Queue):
+        with self._lock:
+            if q in self._watchers:
+                self._watchers.remove(q)
+
+    def _broadcast_locked(self, etype: str, pod: dict):
+        event = {"type": etype, "object": json.loads(json.dumps(pod))}
+        for q in self._watchers:
+            q.put(event)
+
+    def create_pod(self, manifest: dict) -> dict:
+        with self._lock:
+            pod = json.loads(json.dumps(manifest))
+            name = pod["metadata"]["name"]
+            self._uid += 1
+            pod["metadata"].setdefault("uid", f"uid-{self._uid}")
+            pod["metadata"]["resourceVersion"] = self._next_rv()
+            pod["status"] = {"phase": "Pending"}
+            self._pods[name] = pod
+            self.create_log.append(name)
+            self._broadcast_locked("ADDED", pod)
+            if self.auto_run and self.schedulable:
+                self._set_phase_locked(name, "Running")
+            return json.loads(json.dumps(pod))
+
+    def get_pod(self, name: str) -> Optional[dict]:
+        with self._lock:
+            pod = self._pods.get(name)
+            return json.loads(json.dumps(pod)) if pod else None
+
+    def list_pods(self, selector: str = "") -> List[dict]:
+        with self._lock:
+            return [
+                json.loads(json.dumps(p))
+                for p in self._pods.values()
+                if not selector
+                or _match_selector(p["metadata"].get("labels", {}), selector)
+            ]
+
+    def delete_pod(self, name: str) -> bool:
+        with self._lock:
+            pod = self._pods.pop(name, None)
+            if pod is None:
+                return False
+            pod["metadata"]["resourceVersion"] = self._next_rv()
+            self._broadcast_locked("DELETED", pod)
+            return True
+
+    # -- test controls --------------------------------------------------
+
+    def _set_phase_locked(
+        self, name: str, phase: str, exit_code: Optional[int] = None
+    ):
+        pod = self._pods[name]
+        pod["status"]["phase"] = phase
+        if phase == "Running":
+            pod["status"]["podIP"] = "10.0.0.%d" % (self._uid % 250 + 1)
+        if exit_code is not None:
+            pod["status"]["containerStatuses"] = [
+                {"state": {"terminated": {"exitCode": exit_code}}}
+            ]
+        pod["metadata"]["resourceVersion"] = self._next_rv()
+        self._broadcast_locked("MODIFIED", pod)
+
+    def set_running(self, name: str):
+        with self._lock:
+            self._set_phase_locked(name, "Running")
+
+    def fail_pod(self, name: str, exit_code: int = 1):
+        with self._lock:
+            self._set_phase_locked(name, "Failed", exit_code)
+
+    def succeed_pod(self, name: str):
+        with self._lock:
+            self._set_phase_locked(name, "Succeeded", 0)
+
+    def succeed_all(self):
+        with self._lock:
+            for name, pod in list(self._pods.items()):
+                if pod["status"]["phase"] in ("Pending", "Running"):
+                    self._set_phase_locked(name, "Succeeded", 0)
+
+    def pod_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._pods)

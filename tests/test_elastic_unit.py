@@ -36,6 +36,41 @@ class TestRendezvous:
         rdv.set_worker_hosts([(0, "h"), (1, "h")])
         assert rdv.report_liveness(0, "h", rid) is True  # stale rendezvous
 
+    def test_deferred_hosts_resolve_via_liveness(self):
+        """Kubernetes worlds: hosts unknown at declaration; the coordinator
+        resolves only once rank 0 advertises its IP over liveness, on a
+        deterministic per-world port (master cannot bind-probe a remote
+        pod's netns)."""
+        from elasticdl_tpu.master.rendezvous_server import (
+            remote_coordinator_port,
+        )
+
+        def boom(host):
+            raise AssertionError("must not bind-probe with unknown hosts")
+
+        rdv = ElasticRendezvous(coordinator_port_fn=boom)
+        rid = rdv.set_worker_hosts([(0, ""), (1, "")])
+        # No coordinator yet: workers keep polling instead of joining.
+        resp = rdv.get_comm_rank(1, "10.0.0.2")
+        assert resp.rank_id == 1 and resp.coordinator_addr == ""
+        # Rank 1 advertising (above) does not resolve the coordinator;
+        # rank 0 advertising does.
+        resp = rdv.get_comm_rank(0, "10.0.0.1")
+        expected_port = remote_coordinator_port(rid)
+        assert resp.coordinator_addr == f"10.0.0.1:{expected_port}"
+        assert list(resp.worker_hosts) == ["10.0.0.1", "10.0.0.2"]
+        # Advertising rides the rank poll, NOT the heartbeat channel: both
+        # workers are still 'never heartbeated', so staleness is judged
+        # against the (long) startup grace, not the liveness timeout.
+        assert rdv.stale_workers(timeout_s=0.0, startup_grace_s=60.0) == []
+        # A re-declared world discards advertised hosts and defers again,
+        # with a different coordinator port (stragglers can't reconnect).
+        rid2 = rdv.set_worker_hosts([(2, ""), (3, "")])
+        assert rdv.get_comm_rank(2).coordinator_addr == ""
+        addr2 = rdv.get_comm_rank(2, "10.0.0.9").coordinator_addr
+        assert addr2 == f"10.0.0.9:{remote_coordinator_port(rid2)}"
+        assert remote_coordinator_port(rid2) != expected_port
+
 
 class TestCheckpointSaver:
     def test_save_load_roundtrip_and_gc(self, tmp_path):

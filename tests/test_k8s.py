@@ -1,0 +1,459 @@
+"""Kubernetes substrate tests: client, pod manager, submission.
+
+The fake API server (tests/fake_k8s.py) speaks the real wire protocol, so
+these tests exercise K8sClient's HTTP/watch code and the pod manager's full
+churn -> recover -> re-form sequence — the same lifecycle
+tests/test_allreduce_e2e.py proves with real subprocesses.
+"""
+
+import os
+import textwrap
+import time
+
+import pytest
+
+from elasticdl_tpu.master.k8s_client import (
+    K8sClient,
+    K8sConfig,
+    job_label_selector,
+    pod_exit_code,
+    pod_name,
+    pod_phase,
+    render_pod,
+)
+from elasticdl_tpu.master.k8s_pod_manager import (
+    PREEMPTED_EXIT_CODE,
+    KubernetesPodManager,
+)
+
+from fake_k8s import FakeK8sApiServer
+
+
+@pytest.fixture()
+def fake_k8s():
+    server = FakeK8sApiServer().start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def client(fake_k8s):
+    return K8sClient(K8sConfig(host=fake_k8s.host, namespace="testns"))
+
+
+def _wait_for(predicate, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"Timed out waiting for {msg}")
+
+
+class RecordingTaskManager:
+    def __init__(self):
+        self.recovered = []
+        self._finished = False
+
+    def recover_tasks(self, worker_id):
+        self.recovered.append(worker_id)
+
+    def finished(self):
+        return self._finished
+
+
+def _manager(client, fake_k8s, n=2, **kwargs):
+    tm = RecordingTaskManager()
+    kwargs.setdefault("poll_interval_s", 0.05)
+    kwargs.setdefault("pod_startup_timeout_s", 0)
+    manager = KubernetesPodManager(
+        num_workers=n,
+        worker_argv_fn=lambda wid: ["python", "-m", "worker", str(wid)],
+        k8s_client=client,
+        job_name="testjob",
+        image="elasticdl:test",
+        task_manager=tm,
+        job_finished_fn=tm.finished,
+        **kwargs,
+    )
+    return manager, tm
+
+
+# ----------------------------------------------------------------------
+# K8sClient against the fake API server
+# ----------------------------------------------------------------------
+
+
+def test_client_pod_crud(client):
+    manifest = render_pod(
+        job_name="crud", replica_type="worker", index=0,
+        image="img", command=["run"], namespace="testns",
+        resources={"cpu": "2"},
+    )
+    created = client.create_pod(manifest)
+    assert created["metadata"]["name"] == "elasticdl-crud-worker-0"
+    assert pod_phase(created) in ("Pending", "Running")
+
+    got = client.get_pod("elasticdl-crud-worker-0")
+    assert got is not None
+    assert got["spec"]["containers"][0]["resources"]["requests"] == {"cpu": "2"}
+
+    assert client.get_pod("nope") is None
+
+    pods = client.list_pods(job_label_selector("crud"))
+    assert [p["metadata"]["name"] for p in pods] == ["elasticdl-crud-worker-0"]
+    assert client.list_pods(job_label_selector("otherjob")) == []
+
+    assert client.delete_pod("elasticdl-crud-worker-0")
+    assert not client.delete_pod("elasticdl-crud-worker-0")
+
+
+def test_client_watch_stream(client, fake_k8s):
+    manifest = render_pod(
+        job_name="w", replica_type="worker", index=0,
+        image="img", command=["run"], namespace="testns",
+    )
+    client.create_pod(manifest)
+    name = manifest["metadata"]["name"]
+    events = []
+    for etype, pod in client.watch_pods(
+        job_label_selector("w"), timeout_s=5.0
+    ):
+        events.append((etype, pod_phase(pod)))
+        if etype == "ADDED":
+            fake_k8s.fail_pod(name, exit_code=3)
+        if etype == "MODIFIED":
+            assert pod_exit_code(pod) == 3
+            fake_k8s.delete_pod(name)
+        if etype == "DELETED":
+            break
+    assert [e[0] for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+
+
+def test_kubeconfig_parsing(tmp_path):
+    ca = tmp_path / "ca.pem"
+    ca.write_text("CERT")
+    cfg = tmp_path / "config"
+    cfg.write_text(
+        textwrap.dedent(
+            f"""
+            apiVersion: v1
+            current-context: dev
+            clusters:
+            - name: devcluster
+              cluster:
+                server: https://10.1.2.3:6443
+                certificate-authority: {ca}
+            users:
+            - name: devuser
+              user:
+                token: sekrit
+            contexts:
+            - name: dev
+              context:
+                cluster: devcluster
+                user: devuser
+                namespace: ml
+            """
+        )
+    )
+    config = K8sConfig.from_kubeconfig(str(cfg))
+    assert config.host == "https://10.1.2.3:6443"
+    assert config.token == "sekrit"
+    assert config.ca_file == str(ca)
+    assert config.namespace == "ml"
+
+
+# ----------------------------------------------------------------------
+# KubernetesPodManager lifecycle
+# ----------------------------------------------------------------------
+
+
+def test_pod_manager_clean_completion(client, fake_k8s):
+    manager, _ = _manager(client, fake_k8s, n=2)
+    manager.start()
+    try:
+        _wait_for(
+            lambda: len(fake_k8s.pod_names()) == 2, msg="2 worker pods"
+        )
+        assert fake_k8s.pod_names() == [
+            pod_name("testjob", "worker", 0),
+            pod_name("testjob", "worker", 1),
+        ]
+        fake_k8s.succeed_all()
+        assert manager.wait(timeout=10)
+    finally:
+        manager.stop()
+
+
+def test_pod_manager_churn_reform_recover(client, fake_k8s):
+    """A pod failure re-forms the world: tasks of BOTH workers recovered,
+    the survivor deleted, a fresh world launched with new worker ids —
+    the same sequence the subprocess e2e proves."""
+    manager, tm = _manager(client, fake_k8s, n=2)
+    manager.start()
+    try:
+        _wait_for(lambda: len(fake_k8s.pod_names()) == 2, msg="world 1")
+        fake_k8s.fail_pod(pod_name("testjob", "worker", 0), exit_code=1)
+        _wait_for(
+            lambda: sorted(manager.current_worker_ids()) == [2, 3],
+            msg="world 2 with fresh ids",
+        )
+        # Both members of the dead world had their tasks recovered.
+        assert sorted(tm.recovered) == [0, 1]
+        # The survivor was deleted with the world.
+        assert pod_name("testjob", "worker", 1) not in fake_k8s.pod_names()
+        assert fake_k8s.create_log.count(pod_name("testjob", "worker", 2)) == 1
+        fake_k8s.succeed_all()
+        assert manager.wait(timeout=10)
+    finally:
+        manager.stop()
+
+
+def test_pod_manager_preemption_via_delete(client, fake_k8s):
+    """A pod deleted out from under us (node preemption / kubectl delete)
+    reads as churn with exit 137, not as clean completion."""
+    manager, tm = _manager(client, fake_k8s, n=2)
+    manager.start()
+    try:
+        _wait_for(lambda: len(fake_k8s.pod_names()) == 2, msg="world 1")
+        fake_k8s.delete_pod(pod_name("testjob", "worker", 1))
+        _wait_for(
+            lambda: sorted(manager.current_worker_ids()) == [2, 3],
+            msg="world re-formed after preemption",
+        )
+        assert sorted(tm.recovered) == [0, 1]
+        fake_k8s.succeed_all()
+        assert manager.wait(timeout=10)
+    finally:
+        manager.stop()
+
+
+def test_pod_manager_kill_worker(client, fake_k8s):
+    """kill_worker (fault injection) deletes the pod and the death counts
+    as churn — the manager's own teardowns don't."""
+    manager, tm = _manager(client, fake_k8s, n=2)
+    manager.start()
+    try:
+        _wait_for(lambda: len(fake_k8s.pod_names()) == 2, msg="world 1")
+        manager.kill_worker(0)
+        _wait_for(
+            lambda: sorted(manager.current_worker_ids()) == [2, 3],
+            msg="world re-formed after kill",
+        )
+        fake_k8s.succeed_all()
+        assert manager.wait(timeout=10)
+    finally:
+        manager.stop()
+
+
+def test_pod_manager_budget_shrinks_world(client, fake_k8s):
+    manager, _ = _manager(client, fake_k8s, n=2, max_restarts=0)
+    manager.start()
+    try:
+        _wait_for(lambda: len(fake_k8s.pod_names()) == 2, msg="world 1")
+        fake_k8s.fail_pod(pod_name("testjob", "worker", 0))
+        _wait_for(
+            lambda: manager.current_worker_ids() == [2],
+            msg="world shrunk to 1",
+        )
+        fake_k8s.succeed_all()
+        assert manager.wait(timeout=10)
+    finally:
+        manager.stop()
+
+
+def test_pod_manager_scale_up_when_capacity_returns(client, fake_k8s):
+    """Elastic rejoin, two-phase: budget-exhausted churn shrinks 2 -> 1;
+    when the oracle grants a slot, a probe pod schedules (world untouched),
+    goes Running (capacity proven), and only then does the world re-form
+    at size 2."""
+    capacity = {"slots": 0}
+    manager, tm = _manager(
+        client,
+        fake_k8s,
+        n=2,
+        max_restarts=0,
+        scale_up_check_fn=lambda needed: min(needed, capacity["slots"]),
+    )
+    manager.start()
+    try:
+        _wait_for(lambda: len(fake_k8s.pod_names()) == 2, msg="world 1")
+        fake_k8s.fail_pod(pod_name("testjob", "worker", 0))
+        _wait_for(
+            lambda: manager.current_worker_ids() == [2], msg="shrunk world"
+        )
+        capacity["slots"] = 1
+        # Probe pod (id 3) schedules and runs -> commit re-forms at ids 4,5.
+        _wait_for(
+            lambda: sorted(manager.current_worker_ids()) == [4, 5],
+            msg="world grown back to 2",
+        )
+        # The shrunk world's tasks were recovered before regrowth, and the
+        # probe pod did not survive into the new world.
+        assert 2 in tm.recovered
+        assert pod_name("testjob", "worker", 3) not in fake_k8s.pod_names()
+        fake_k8s.succeed_all()
+        assert manager.wait(timeout=10)
+    finally:
+        manager.stop()
+
+
+def test_pod_manager_scale_up_probe_backs_off_without_capacity(
+    client, fake_k8s
+):
+    """A capacity-starved cluster: the probe pod sits Pending, the probe
+    aborts after the startup timeout, the healthy world is NEVER torn
+    down, no restart budget is burned, and the oracle backs off."""
+    calls = {"failed": 0}
+
+    class Oracle:
+        granted = False
+
+        def __call__(self, needed):
+            return needed if self.granted else 0
+
+        def failed(self):
+            calls["failed"] += 1
+
+        def succeeded(self):
+            pass
+
+    oracle = Oracle()
+    manager, _ = _manager(
+        client,
+        fake_k8s,
+        n=2,
+        max_restarts=0,
+        target_num_workers=3,
+        scale_up_check_fn=oracle,
+        pod_startup_timeout_s=0.3,
+    )
+    manager.start()
+    try:
+        _wait_for(lambda: len(fake_k8s.pod_names()) == 2, msg="world 1")
+        fake_k8s.schedulable = False  # probe pods will stay Pending
+        oracle.granted = True
+        _wait_for(lambda: calls["failed"] >= 1, msg="probe abort + backoff")
+        # Healthy world untouched; probe pod cleaned up.
+        assert sorted(manager.current_worker_ids()) == [0, 1]
+        assert pod_name("testjob", "worker", 2) not in fake_k8s.pod_names()
+        fake_k8s.succeed_all()
+        assert manager.wait(timeout=10)
+    finally:
+        manager.stop()
+
+
+def test_pod_manager_resync_marks_vanished_pods(client, fake_k8s):
+    """_resync after a watch outage marks cached pods missing from the
+    re-list as deleted, so their churn still surfaces."""
+    manager, _ = _manager(client, fake_k8s, n=1)
+    handles = manager._substrate_launch([0])
+    name = handles[0].name
+    manager._resync()
+    assert manager._substrate_poll(handles[0]) is None
+    # Pod vanishes while the watch is down (no watcher running here).
+    fake_k8s.delete_pod(name)
+    manager._resync()
+    assert manager._substrate_poll(handles[0]) == PREEMPTED_EXIT_CODE
+    assert manager._resource_version  # list RV captured for watch resume
+
+
+def test_pod_manager_pending_timeout_is_churn(client, fake_k8s):
+    """Unschedulable pods (capacity starvation) convert to churn via the
+    startup timeout instead of wedging the job forever."""
+    fake_k8s.schedulable = False
+    manager, _ = _manager(
+        client, fake_k8s, n=1, max_restarts=0, pod_startup_timeout_s=0.3
+    )
+    manager.start()
+    try:
+        assert not manager.wait(timeout=15)
+        assert "restart budget exhausted" in manager.failed_reason
+    finally:
+        manager.stop()
+
+
+def test_pod_manager_sweeps_leftover_pods(client, fake_k8s):
+    """A new master incarnation deletes its predecessor's worker pods
+    before world 1 — pod names would otherwise collide and 409s would be
+    misread as churn (master-restart resume on k8s depends on this)."""
+    stale = render_pod(
+        job_name="testjob", replica_type="worker", index=0,
+        image="old", command=["run"], namespace="testns",
+    )
+    client.create_pod(stale)
+    manager, _ = _manager(client, fake_k8s, n=2)
+    manager.start()
+    try:
+        _wait_for(
+            lambda: sorted(manager.current_worker_ids()) == [0, 1],
+            msg="fresh world despite name collision",
+        )
+        pod = client.get_pod(pod_name("testjob", "worker", 0))
+        assert pod["spec"]["containers"][0]["image"] == "elasticdl:test"
+        fake_k8s.succeed_all()
+        assert manager.wait(timeout=10)
+    finally:
+        manager.stop()
+
+
+def test_parse_volume_spec():
+    from elasticdl_tpu.master.k8s_client import parse_volume_spec
+
+    volumes, mounts = parse_volume_spec(
+        "claim_name=ckpt-pvc,mount_path=/ckpt;"
+        "host_path=/mnt/nfs,mount_path=/data,read_only=true"
+    )
+    assert volumes[0]["persistentVolumeClaim"]["claimName"] == "ckpt-pvc"
+    assert mounts[0]["mountPath"] == "/ckpt"
+    assert volumes[1]["hostPath"]["path"] == "/mnt/nfs"
+    assert mounts[1]["readOnly"] is True
+    assert volumes[0]["name"] == mounts[0]["name"]
+    with pytest.raises(ValueError):
+        parse_volume_spec("claim_name=x")  # no mount_path
+    with pytest.raises(ValueError):
+        parse_volume_spec("mount_path=/x")  # no source
+
+
+# ----------------------------------------------------------------------
+# Submission
+# ----------------------------------------------------------------------
+
+
+def test_submit_job_creates_master_pod(client, fake_k8s):
+    from elasticdl_tpu.client.submit import submit_job
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.constants import Mode
+
+    args = parse_master_args(
+        [
+            "--job_name=subjob",
+            "--image_name=elasticdl:test",
+            "--namespace=testns",
+            "--model_zoo=/zoo",
+            "--model_def=mnist.custom_model",
+            "--training_data=/data/train",
+            "--num_workers=3",
+            "--master_resource_request=cpu=1,memory=2Gi",
+            "--distribution_strategy=AllreduceStrategy",
+        ]
+    )
+    assert submit_job(args, Mode.TRAINING, k8s_client=client) == 0
+    pods = fake_k8s.pod_names()
+    assert pods == ["elasticdl-subjob-master-0"]
+    pod = client.get_pod("elasticdl-subjob-master-0")
+    command = pod["spec"]["containers"][0]["command"]
+    assert command[:3] == ["python", "-m", "elasticdl_tpu.master.main"]
+    assert "--job_type=training_only" in command
+    # Flags round-trip so the master pod can re-render worker pods.
+    joined = " ".join(command)
+    assert "--num_workers 3" in joined
+    assert "--image_name=elasticdl:test" in joined
+    assert pod["spec"]["containers"][0]["resources"]["requests"] == {
+        "cpu": "1",
+        "memory": "2Gi",
+    }
+    labels = pod["metadata"]["labels"]
+    assert labels["elasticdl-job-name"] == "subjob"
+    assert labels["elasticdl-replica-type"] == "master"
