@@ -14,10 +14,35 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.master.k8s_client import (
     K8sClient,
     K8sConfig,
+    parse_resource_spec,
+    parse_volume_spec,
     render_pod,
 )
 
 logger = get_logger("client.submit")
+
+
+def validate_cluster_args(args, mode: str):
+    """Pre-flight checks at submission time.  Anything that would make the
+    master pod die on arrival (restartPolicy=Never — no second chance)
+    should fail HERE, in the operator's terminal, not in kubectl logs of a
+    Failed pod after the client already printed 'submitted'."""
+    parse_resource_spec(args.master_resource_request)
+    parse_resource_spec(args.worker_resource_request)
+    parse_volume_spec(args.volume)
+    if (
+        mode == Mode.TRAINING
+        and args.need_elasticity
+        and not args.checkpoint_dir
+    ):
+        # Mirrors job_runner._ensure_elastic_checkpointing's in-cluster
+        # refusal: a master-pod-local default dir is invisible to workers.
+        raise ValueError(
+            "Elastic training on Kubernetes requires --checkpoint_dir on "
+            "storage every pod shares — mount it with --volume "
+            '(e.g. --volume "claim_name=ckpt-pvc,mount_path=/ckpt" '
+            "--checkpoint_dir /ckpt/myjob)."
+        )
 
 # Client-side / derived flags that must not round-trip into the master pod
 # command line.
@@ -41,8 +66,6 @@ def job_type_for(args, mode: str) -> str:
 
 
 def render_master_pod(args, mode: str) -> dict:
-    from elasticdl_tpu.master.job_runner import _parse_resources
-
     keys = {k for k in vars(args) if k not in _NO_FORWARD}
     command = [
         "python",
@@ -59,7 +82,7 @@ def render_master_pod(args, mode: str) -> dict:
         image=args.image_name,
         command=command,
         namespace=args.namespace,
-        resources=_parse_resources(args.master_resource_request) or None,
+        resources=parse_resource_spec(args.master_resource_request) or None,
         priority_class=args.worker_pod_priority,
         volume_spec=args.volume,
     )
@@ -67,6 +90,7 @@ def render_master_pod(args, mode: str) -> dict:
 
 def submit_job(args, mode: str, k8s_client: K8sClient = None) -> int:
     """Create the master pod and return; the cluster runs the job."""
+    validate_cluster_args(args, mode)
     client = k8s_client or K8sClient(K8sConfig.resolve(args.namespace))
     manifest = render_master_pod(args, mode)
     created = client.create_pod(manifest)

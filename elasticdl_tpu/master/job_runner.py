@@ -76,18 +76,6 @@ class _K8sCapacityProbe:
         self._cooldown_s = self._base_cooldown_s
 
 
-def _parse_resources(spec: str) -> dict:
-    """'cpu=1,memory=2Gi' -> {'cpu': '1', 'memory': '2Gi'} (k8s quantities
-    stay strings; the API server owns their grammar)."""
-    out = {}
-    for item in filter(None, (s.strip() for s in spec.split(","))):
-        if "=" not in item:
-            raise ValueError(f"Malformed resource {item!r} in {spec!r}")
-        key, value = item.split("=", 1)
-        out[key.strip()] = value.strip()
-    return out
-
-
 def _running_on_k8s(args) -> bool:
     return bool(args.image_name) and bool(
         os.environ.get("KUBERNETES_SERVICE_HOST")
@@ -108,7 +96,11 @@ def _build_worker_manager(args, master, rendezvous, worker_env):
         liveness_timeout_s=args.worker_liveness_timeout_s,
     )
     if _running_on_k8s(args):
-        from elasticdl_tpu.master.k8s_client import K8sClient, K8sConfig
+        from elasticdl_tpu.master.k8s_client import (
+            K8sClient,
+            K8sConfig,
+            parse_resource_spec,
+        )
         from elasticdl_tpu.master.k8s_pod_manager import KubernetesPodManager
 
         client = K8sClient(K8sConfig.resolve(args.namespace))
@@ -126,7 +118,7 @@ def _build_worker_manager(args, master, rendezvous, worker_env):
             job_name=args.job_name,
             image=args.image_name,
             worker_env=worker_env,
-            worker_resources=_parse_resources(args.worker_resource_request)
+            worker_resources=parse_resource_spec(args.worker_resource_request)
             or None,
             priority_class=args.worker_pod_priority,
             owner_pod=owner,

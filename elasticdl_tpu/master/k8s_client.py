@@ -393,6 +393,18 @@ def _env_list(env: Dict[str, str]) -> List[dict]:
     return entries
 
 
+def parse_resource_spec(spec: str) -> Dict[str, str]:
+    """'cpu=1,memory=2Gi' -> {'cpu': '1', 'memory': '2Gi'} (k8s quantities
+    stay strings; the API server owns their grammar)."""
+    out: Dict[str, str] = {}
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        if "=" not in item:
+            raise ValueError(f"Malformed resource {item!r} in {spec!r}")
+        key, value = item.split("=", 1)
+        out[key.strip()] = value.strip()
+    return out
+
+
 def parse_volume_spec(spec: str):
     """Parse the --volume flag into (volumes, volumeMounts).
 

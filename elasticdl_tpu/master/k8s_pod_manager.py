@@ -58,13 +58,17 @@ class PodHandle:
 
 
 class _PodState:
-    __slots__ = ("phase", "exit_code", "deleted", "pod_ip")
+    __slots__ = ("phase", "exit_code", "deleted", "pod_ip", "uid")
 
-    def __init__(self):
+    def __init__(self, uid: str = ""):
         self.phase = "Pending"
         self.exit_code: Optional[int] = None
         self.deleted = False
         self.pod_ip = ""
+        # uid of the pod *this manager created* under the name; events
+        # carrying a different uid belong to a stale namesake (409-replace,
+        # predecessor sweep races) and must not clobber this state.
+        self.uid = uid
 
 
 class KubernetesPodManager(ElasticWorkerManager):
@@ -169,7 +173,7 @@ class KubernetesPodManager(ElasticWorkerManager):
         listed = {p["metadata"]["name"]: p for p in listing.get("items", [])}
         with self._state_lock:
             for pod in listed.values():
-                self._apply_pod_locked(pod)
+                self._apply_pod_locked(pod, authoritative=True)
             for name, state in self._pod_states.items():
                 if name not in listed:
                     state.deleted = True
@@ -193,10 +197,11 @@ class KubernetesPodManager(ElasticWorkerManager):
                     with self._state_lock:
                         if etype == "DELETED":
                             name = pod["metadata"]["name"]
-                            state = self._pod_states.setdefault(
-                                name, _PodState()
-                            )
-                            state.deleted = True
+                            state = self._pod_states.get(name)
+                            if state is not None and self._uid_matches(
+                                state, pod
+                            ):
+                                state.deleted = True
                         else:
                             self._apply_pod_locked(pod)
                     if self._watch_stop.is_set():
@@ -213,9 +218,27 @@ class KubernetesPodManager(ElasticWorkerManager):
                 logger.warning("Pod watch dropped (%s); reconnecting", exc)
                 time.sleep(0.5)
 
-    def _apply_pod_locked(self, pod: dict):
+    @staticmethod
+    def _uid_matches(state: "_PodState", pod: dict) -> bool:
+        event_uid = (pod.get("metadata") or {}).get("uid", "")
+        return not state.uid or not event_uid or state.uid == event_uid
+
+    def _apply_pod_locked(self, pod: dict, authoritative: bool = False):
+        """Fold one pod object into the cache.  Watch events for pods we
+        aren't tracking (pruned after teardown) or for a uid we did not
+        create (stale namesakes) are ignored; a re-list (`authoritative`)
+        reflects current cluster truth and wins."""
         name = pod["metadata"]["name"]
-        state = self._pod_states.setdefault(name, _PodState())
+        state = self._pod_states.get(name)
+        if state is None:
+            if not authoritative:
+                return
+            self._pod_states[name] = state = _PodState()
+        if not self._uid_matches(state, pod):
+            if not authoritative:
+                return
+            self._pod_states[name] = state = _PodState()
+        state.uid = state.uid or (pod.get("metadata") or {}).get("uid", "")
         state.phase = pod_phase(pod)
         code = pod_exit_code(pod)
         if code is not None:
@@ -248,7 +271,12 @@ class KubernetesPodManager(ElasticWorkerManager):
                 self._we_deleted.discard(name)
                 self._created_at[name] = time.time()
             try:
-                self._create_pod_replacing(manifest, name)
+                created = self._create_pod_replacing(manifest, name)
+                with self._state_lock:
+                    # Pin the created uid so late DELETED/MODIFIED events
+                    # from a stale namesake can't clobber this pod's state.
+                    state = self._pod_states[name]
+                    state.uid = (created.get("metadata") or {}).get("uid", "")
             except ApiError as e:
                 # Leave the handle in place; poll will surface the failure
                 # as churn and the budget decides what happens next.
@@ -260,12 +288,11 @@ class KubernetesPodManager(ElasticWorkerManager):
             logger.info("Created worker pod %s", name)
         return handles
 
-    def _create_pod_replacing(self, manifest: dict, name: str):
+    def _create_pod_replacing(self, manifest: dict, name: str) -> dict:
         """Create, tolerating one 409 AlreadyExists by deleting the stale
         namesake first (a racing predecessor pod the sweep missed)."""
         try:
-            self._client.create_pod(manifest)
-            return
+            return self._client.create_pod(manifest)
         except ApiError as e:
             if e.status != 409:
                 raise
@@ -276,7 +303,7 @@ class KubernetesPodManager(ElasticWorkerManager):
             if time.time() > deadline:
                 raise ApiError(409, "AlreadyExists", f"{name} stuck terminating")
             time.sleep(0.1)
-        self._client.create_pod(manifest)
+        return self._client.create_pod(manifest)
 
     def _substrate_poll(self, handle: PodHandle) -> Optional[int]:
         with self._state_lock:
@@ -332,6 +359,14 @@ class KubernetesPodManager(ElasticWorkerManager):
                 if gone or self._client.get_pod(h.name) is None:
                     break
                 time.sleep(0.1)
+        # Terminated pods are never polled again (handles are discarded by
+        # every caller); prune their cache entries or a churn-heavy job
+        # accumulates unbounded per-pod state across world re-formations.
+        with self._state_lock:
+            for h in handles:
+                self._pod_states.pop(h.name, None)
+                self._we_deleted.discard(h.name)
+                self._created_at.pop(h.name, None)
 
     def _substrate_kill(self, handle: PodHandle, sig: int = 9):
         # No signal vocabulary in the pods API; grace-0 delete == SIGKILL.

@@ -161,11 +161,14 @@ class ElasticWorkerManager:
     def kill_worker(self, worker_id: int, sig: int = 9):
         """Fault injection / preemption simulation: kill one worker."""
         with self._lock:
-            for h in self._handles:
-                if h.worker_id == worker_id:
-                    self._substrate_kill(h, sig)
-                    return
-        raise ValueError(f"No live worker {worker_id}")
+            target = next(
+                (h for h in self._handles if h.worker_id == worker_id), None
+            )
+        if target is None:
+            raise ValueError(f"No live worker {worker_id}")
+        # Kill outside the lock: on Kubernetes this is a blocking HTTP
+        # DELETE that must not stall the monitor loop's lock acquisitions.
+        self._substrate_kill(target, sig)
 
     def scale(self, num_workers: int):
         """Explicit elastic resize: tear down and relaunch at the new size."""

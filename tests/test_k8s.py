@@ -426,19 +426,20 @@ def test_submit_job_creates_master_pod(client, fake_k8s):
     from elasticdl_tpu.common.args import parse_master_args
     from elasticdl_tpu.common.constants import Mode
 
-    args = parse_master_args(
-        [
-            "--job_name=subjob",
-            "--image_name=elasticdl:test",
-            "--namespace=testns",
-            "--model_zoo=/zoo",
-            "--model_def=mnist.custom_model",
-            "--training_data=/data/train",
-            "--num_workers=3",
-            "--master_resource_request=cpu=1,memory=2Gi",
-            "--distribution_strategy=AllreduceStrategy",
-        ]
-    )
+    argv = [
+        "--job_name=subjob",
+        "--image_name=elasticdl:test",
+        "--namespace=testns",
+        "--model_zoo=/zoo",
+        "--model_def=mnist.custom_model",
+        "--training_data=/data/train",
+        "--num_workers=3",
+        "--master_resource_request=cpu=1,memory=2Gi",
+        "--distribution_strategy=AllreduceStrategy",
+        "--volume=claim_name=ckpt-pvc,mount_path=/ckpt",
+        "--checkpoint_dir=/ckpt/subjob",
+    ]
+    args = parse_master_args(argv)
     assert submit_job(args, Mode.TRAINING, k8s_client=client) == 0
     pods = fake_k8s.pod_names()
     assert pods == ["elasticdl-subjob-master-0"]
@@ -457,3 +458,30 @@ def test_submit_job_creates_master_pod(client, fake_k8s):
     labels = pod["metadata"]["labels"]
     assert labels["elasticdl-job-name"] == "subjob"
     assert labels["elasticdl-replica-type"] == "master"
+    # The shared checkpoint volume is mounted into the master pod.
+    assert pod["spec"]["volumes"][0]["persistentVolumeClaim"][
+        "claimName"
+    ] == "ckpt-pvc"
+
+
+def test_submit_rejects_elastic_job_without_shared_checkpoint(client):
+    """Pre-flight: a config that would kill the master pod on arrival
+    (elastic training, no shared checkpoint_dir) fails in the client's
+    terminal, before anything is created in the cluster."""
+    from elasticdl_tpu.client.submit import submit_job
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.constants import Mode
+
+    args = parse_master_args(
+        [
+            "--job_name=badjob",
+            "--image_name=elasticdl:test",
+            "--model_zoo=/zoo",
+            "--model_def=m.f",
+            "--training_data=/data",
+            "--distribution_strategy=AllreduceStrategy",
+        ]
+    )
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        submit_job(args, Mode.TRAINING, k8s_client=client)
+    assert client.list_pods() == []
