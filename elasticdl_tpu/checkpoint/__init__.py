@@ -1,1 +1,5 @@
 from elasticdl_tpu.checkpoint.saver import CheckpointSaver  # noqa: F401
+from elasticdl_tpu.checkpoint.sharded import (  # noqa: F401
+    RowReader,
+    ShardedCheckpointSaver,
+)

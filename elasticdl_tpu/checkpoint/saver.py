@@ -42,10 +42,17 @@ class CheckpointSaver:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self._dir, f"step_{step:012d}")
 
+    def _is_committed(self, step_dir: str) -> bool:
+        """Validity hook: subclasses narrow what counts as a complete
+        checkpoint (e.g. sharded saves require their manifest)."""
+        return True
+
     def steps(self):
         steps = []
         for name in os.listdir(self._dir):
             if name.startswith("step_") and ".tmp" not in name:
+                if not self._is_committed(os.path.join(self._dir, name)):
+                    continue
                 try:
                     steps.append(int(name[len("step_"):]))
                 except ValueError:

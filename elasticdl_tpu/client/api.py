@@ -90,7 +90,7 @@ def _run_local(args, mode: str):
     try:
         worker.run()
         if mode == Mode.TRAINING and args.output:
-            save_model(worker.trainer, args.output)
+            save_model(worker.trainer, args.output, args)
         metrics = {}
         if master.evaluation_service is not None:
             master.evaluation_service.finalize()
@@ -103,12 +103,30 @@ def _run_local(args, mode: str):
         master.stop()
 
 
-def save_model(trainer, output_path: str):
-    """Export trained variables as an .npz artifact (orbax ckpt in phase 7)."""
-    variables = trainer.get_variables_numpy()
-    if not variables:
+def save_model(trainer, output_path: str, args=None):
+    """Export the trained model as a servable artifact directory (the
+    reference's `get_model_to_export` analogue — serving/export.py).
+    A legacy flat-variables `.npz` is still written when the path ends in
+    `.npz` (external consumers of the round-1 format)."""
+    if trainer.state is None:
         logger.warning("No variables to save (model never initialized)")
         return
-    np.savez(output_path if output_path.endswith(".npz") else output_path + ".npz",
-             **variables)
-    logger.info("Saved %d variables to %s", len(variables), output_path)
+    if output_path.endswith(".npz"):
+        import jax
+
+        variables = trainer.get_variables_numpy()  # collective (PS tables)
+        if jax.process_index() == 0:
+            np.savez(output_path, **variables)
+            logger.info(
+                "Saved %d variables to %s", len(variables), output_path
+            )
+        return
+    from elasticdl_tpu.serving import export_model
+
+    export_model(
+        trainer,
+        output_path,
+        model_zoo=getattr(args, "model_zoo", ""),
+        model_def=getattr(args, "model_def", ""),
+        model_params=getattr(args, "model_params", ""),
+    )

@@ -171,12 +171,27 @@ class KubernetesPodManager(ElasticWorkerManager):
         list's resourceVersion is the correct watch-resume point."""
         listing = self._client.list_pods_raw(self._selector)
         listed = {p["metadata"]["name"]: p for p in listing.get("items", [])}
+        with self._lock:
+            tracked = {h.name for h in self._handles} | {
+                h.name for h in self._probe_handles
+            }
         with self._state_lock:
-            for pod in listed.values():
-                self._apply_pod_locked(pod, authoritative=True)
-            for name, state in self._pod_states.items():
-                if name not in listed:
-                    state.deleted = True
+            for name, pod in listed.items():
+                # Untracked listed pods (terminating members of torn-down
+                # worlds) get no cache entry — the teardown prune removed
+                # them and nothing would ever prune them again.
+                if name in tracked or name in self._pod_states:
+                    self._apply_pod_locked(pod, authoritative=True)
+            for name in list(self._pod_states):
+                if name in listed:
+                    continue
+                if name in tracked:
+                    # Vanished while the watch was down: surfaces as churn.
+                    self._pod_states[name].deleted = True
+                else:
+                    self._pod_states.pop(name)
+                    self._we_deleted.discard(name)
+                    self._created_at.pop(name, None)
         rv = (listing.get("metadata") or {}).get("resourceVersion", "")
         if rv:
             self._resource_version = rv
@@ -273,10 +288,21 @@ class KubernetesPodManager(ElasticWorkerManager):
             try:
                 created = self._create_pod_replacing(manifest, name)
                 with self._state_lock:
-                    # Pin the created uid so late DELETED/MODIFIED events
-                    # from a stale namesake can't clobber this pod's state.
-                    state = self._pod_states[name]
-                    state.uid = (created.get("metadata") or {}).get("uid", "")
+                    # Pin the created uid.  If events for THIS uid already
+                    # flowed into the placeholder, keep them (replacing
+                    # would discard a Running that may never repeat); if
+                    # the placeholder was polluted by a stale namesake —
+                    # e.g. the 409-replace path let the old pod's DELETED
+                    # mark the unpinned state deleted, which nothing ever
+                    # clears — install a fresh state for the new uid.
+                    uid = (created.get("metadata") or {}).get("uid", "")
+                    existing = self._pod_states.get(name)
+                    if existing is not None and existing.uid == uid:
+                        existing.deleted = False
+                    else:
+                        fresh = _PodState(uid=uid)
+                        fresh.phase = pod_phase(created)
+                        self._pod_states[name] = fresh
             except ApiError as e:
                 # Leave the handle in place; poll will surface the failure
                 # as churn and the budget decides what happens next.

@@ -98,6 +98,7 @@ class ShardedEmbeddingTrainer:
         self._host_step = 0
         self._perturb_shapes: Dict[str, Any] = {}
         self._pending_restore: Optional[PSTrainState] = None
+        self._pending_sharded_restore: Optional[Tuple[Any, int]] = None
         self._train_step = None  # jitted lazily once shardings are known
         self._eval_step = None
 
@@ -169,17 +170,19 @@ class ShardedEmbeddingTrainer:
             slots=slots,
         )
 
-    def _place_state(self, state: PSTrainState) -> PSTrainState:
-        shardings = self._state_shardings(state)
-        return jax.tree.map(
-            lambda x, s: jax.device_put(x, s)
+    @staticmethod
+    def _place_leaf(x, s):
+        return (
+            jax.device_put(x, s)
             if jax.process_count() == 1
             else jax.make_array_from_callback(
                 np.shape(x), s, lambda idx, _x=np.asarray(x): _x[idx]
-            ),
-            state,
-            shardings,
+            )
         )
+
+    def _place_state(self, state: PSTrainState) -> PSTrainState:
+        shardings = self._state_shardings(state)
+        return jax.tree.map(self._place_leaf, state, shardings)
 
     # -- initialization -------------------------------------------------
 
@@ -255,10 +258,13 @@ class ShardedEmbeddingTrainer:
             tables=tables,
             slots=slots,
         )
-        if self._pending_restore is not None:
-            state = self._pending_restore
-            self._pending_restore = None
-        self._state = self._place_state(jax.device_get(state))
+        if self._pending_sharded_restore is not None:
+            self._state = self._restore_sharded(state)
+        else:
+            if self._pending_restore is not None:
+                state = self._pending_restore
+                self._pending_restore = None
+            self._state = self._place_state(jax.device_get(state))
         n_dense = sum(
             int(np.prod(np.shape(p))) for p in jax.tree.leaves(params)
         )
@@ -472,6 +478,93 @@ class ShardedEmbeddingTrainer:
         features = shd.assemble_global_batch(features, self._mesh)
         outputs = self._eval_step(state, features)
         return shd.gather_to_host(outputs)
+
+    # -- sharded checkpointing -------------------------------------------
+
+    def _sharded_arrays(self, state: PSTrainState) -> Dict[str, jax.Array]:
+        """The mesh-sharded leaves, under stable checkpoint names.  '|' is
+        the name separator (path keys use '/'); row intervals append two
+        more '|' fields in the shard files (checkpoint/sharded.py)."""
+        out = {f"table|{k}": v for k, v in state.tables.items()}
+        for key, group in state.slots.items():
+            for name, v in group.items():
+                out[f"slot|{key}|{name}"] = v
+        return out
+
+    def save_checkpoint(self, saver, step: int) -> None:
+        """COLLECTIVE sharded checkpoint (checkpoint/sharded.py): every
+        process calls this and writes only its local table/slot rows — no
+        host ever materializes a full table, unlike `state_to_host` (whose
+        full gather OOMs by construction at Criteo scale)."""
+        if self._state is None:
+            return
+        state = self._state
+        # Dense state is replicated and only rank 0 writes it — don't pay
+        # the device->host transfer on the other N-1 ranks' hot path.
+        dense = None
+        if jax.process_index() == 0:
+            dense = {
+                "step": jax.device_get(state.step),
+                "params": jax.device_get(state.params),
+                "opt_state": jax.device_get(state.opt_state),
+                "model_state": jax.device_get(state.model_state),
+            }
+        saver.save(step, dense, self._sharded_arrays(state))
+
+    def set_sharded_restore(self, saver, step: int) -> None:
+        """Defer restore until ensure_initialized has built the model's
+        structure and shardings (worker-boot restore, same contract as the
+        `state` setter's pending path)."""
+        self._pending_sharded_restore = (saver, step)
+        self._host_step = step
+
+    def _restore_sharded(self, template: PSTrainState) -> PSTrainState:
+        """Materialize the checkpoint under the CURRENT world's shardings:
+        dense state replicates from rank 0's pickle; each table/slot row
+        interval is read by whichever process now owns it — world-size
+        agnostic, which is what restart-the-world shrink/grow needs."""
+        saver, step = self._pending_sharded_restore
+        self._pending_sharded_restore = None
+        shardings = self._state_shardings(template)
+        dense = saver.load_dense(step)
+        tables = {
+            k: saver.load_array(step, f"table|{k}", shardings.tables[k])
+            for k in template.tables
+        }
+        slots = {
+            k: {
+                n: saver.load_array(
+                    step, f"slot|{k}|{n}", shardings.slots[k][n]
+                )
+                for n in group
+            }
+            for k, group in template.slots.items()
+        }
+        for k, v in tables.items():
+            assert v.shape == template.tables[k].shape, (
+                f"Checkpoint table {k} shape {v.shape} != model "
+                f"{template.tables[k].shape} (vocab/dim changed?)"
+            )
+        self._host_step = int(np.asarray(dense["step"]))
+        logger.info(
+            "Restored sharded checkpoint at step %d (%d tables)",
+            self._host_step,
+            len(tables),
+        )
+        return PSTrainState(
+            step=self._place_leaf(np.asarray(dense["step"]), shardings.step),
+            params=jax.tree.map(
+                self._place_leaf, dense["params"], shardings.params
+            ),
+            opt_state=jax.tree.map(
+                self._place_leaf, dense["opt_state"], shardings.opt_state
+            ),
+            model_state=jax.tree.map(
+                self._place_leaf, dense["model_state"], shardings.model_state
+            ),
+            tables=tables,
+            slots=slots,
+        )
 
     def state_to_host(self) -> Optional[PSTrainState]:
         """Host-complete snapshot for checkpointing.  Tables/slots are

@@ -1,0 +1,5 @@
+from elasticdl_tpu.serving.export import (  # noqa: F401
+    ServingModel,
+    export_model,
+    load_for_serving,
+)

@@ -90,10 +90,35 @@ class CollectiveWorker:
     def trainer(self) -> DataParallelTrainer:
         return self._trainer
 
+    @property
+    def is_leader(self) -> bool:
+        return self._world.is_leader
+
     # ------------------------------------------------------------------
+
+    @property
+    def _sharded_ckpt(self) -> bool:
+        """Sharded protocol when both sides support it: the trainer keeps
+        mesh-sharded state (PS tables) and the saver speaks per-process
+        shard files (checkpoint/sharded.py) — every rank reads/writes only
+        its own rows instead of rank 0 pickling a full gather."""
+        return hasattr(self._trainer, "save_checkpoint") and hasattr(
+            self._ckpt, "latest_step"
+        )
 
     def restore_from_checkpoint(self):
         if self._ckpt is None:
+            return
+        if self._sharded_ckpt:
+            step = self._ckpt.latest_step()
+            if step is not None:
+                self._trainer.set_sharded_restore(self._ckpt, step)
+                self._last_ckpt_step = step
+                logger.info(
+                    "Rank %d will restore sharded checkpoint at step %d",
+                    self._world.rank,
+                    step,
+                )
             return
         state, step = self._ckpt.load_latest()
         if state is not None:
@@ -330,7 +355,11 @@ class CollectiveWorker:
             self._ckpt_steps and step - self._last_ckpt_step >= self._ckpt_steps
         )
         if due and step > 0 and step != self._last_ckpt_step:
-            host_state = self._trainer.state_to_host()
-            if self._world.is_leader:
-                self._ckpt.save(host_state, step)
+            if self._sharded_ckpt:
+                # Collective: every rank writes its own shard rows.
+                self._trainer.save_checkpoint(self._ckpt, step)
+            else:
+                host_state = self._trainer.state_to_host()
+                if self._world.is_leader:
+                    self._ckpt.save(host_state, step)
             self._last_ckpt_step = step

@@ -61,6 +61,15 @@ def main(argv=None):
             prediction_data_reader=prediction_reader,
         )
     worker.run()
+    if args.output and "training" in args.job_type:
+        # Export the servable artifact at job end (reference: the master's
+        # model handler exports after training).  ALL ranks call this in
+        # lockstep — materializing process-spanning PS tables is a
+        # collective row-gather — and only rank 0 writes; tables stream
+        # out in bounded row chunks, so this works at any table size.
+        from elasticdl_tpu.client.api import save_model
+
+        save_model(worker.trainer, args.output, args)
     return 0
 
 
@@ -98,11 +107,20 @@ def _build_collective_worker(
             optimizer=model_spec.optimizer(),
             mesh=mesh,
         )
-    saver = (
-        CheckpointSaver(args.checkpoint_dir, keep_max=args.keep_checkpoint_max)
-        if args.checkpoint_dir
-        else None
-    )
+    saver = None
+    if args.checkpoint_dir:
+        if args.distribution_strategy == "ParameterServerStrategy":
+            # PS tables are mesh-sharded: per-process shard files, so no
+            # host ever gathers a full table (checkpoint/sharded.py).
+            from elasticdl_tpu.checkpoint import ShardedCheckpointSaver
+
+            saver = ShardedCheckpointSaver(
+                args.checkpoint_dir, keep_max=args.keep_checkpoint_max
+            )
+        else:
+            saver = CheckpointSaver(
+                args.checkpoint_dir, keep_max=args.keep_checkpoint_max
+            )
     return CollectiveWorker(
         master_client=client,
         model_spec=model_spec,

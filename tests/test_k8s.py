@@ -349,6 +349,7 @@ def test_pod_manager_resync_marks_vanished_pods(client, fake_k8s):
     re-list as deleted, so their churn still surfaces."""
     manager, _ = _manager(client, fake_k8s, n=1)
     handles = manager._substrate_launch([0])
+    manager._handles = handles  # as _launch_world would
     name = handles[0].name
     manager._resync()
     assert manager._substrate_poll(handles[0]) is None

@@ -53,8 +53,12 @@ def test_local_train_end_to_end(tmp_path):
     # Loss decreases substantially on the learnable synthetic task.
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7
 
-    saved = np.load(str(tmp_path / "model.npz"))
-    assert any(key.startswith("params/") for key in saved.files)
+    # --output produced a servable artifact a fresh loader can predict from.
+    from elasticdl_tpu.serving import load_for_serving
+
+    served = load_for_serving(str(tmp_path / "model"))
+    out = np.asarray(served.predict(np.zeros((2, 28, 28, 1), np.float32)))
+    assert out.shape == (2, 10) and np.isfinite(out).all()
 
 
 def test_local_evaluate_only(tmp_path):

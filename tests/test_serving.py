@@ -1,0 +1,117 @@
+"""Model-export-for-serving tests (reference: model_handler
+get_model_to_export — SURVEY.md §3.6).
+
+Done-criterion from the round-1 review: `--output` produces an artifact a
+fresh process can serve with bit-identical eval outputs — including
+PS-mode's mesh-sharded embedding tables, which must be materialized into
+the artifact without the exporter holding a full table in memory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from elasticdl_tpu.parallel import MeshConfig, build_mesh
+from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+from elasticdl_tpu.serving import export_model, load_for_serving
+from test_ctr_models import _batches
+
+
+def _trained_deepfm(steps=4):
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    mesh = build_mesh(MeshConfig())
+    trainer = ShardedEmbeddingTrainer(
+        zoo.custom_model(vocab_size=100),
+        zoo.loss,
+        zoo.optimizer(lr=0.01),
+        mesh,
+        embedding_optimizer=zoo.embedding_optimizer(lr=0.01),
+    )
+    batches = list(_batches(zoo, n=64, mb=16))
+    for feats, labels in batches[:steps]:
+        trainer.train_step(feats, labels)
+    return zoo, trainer, batches
+
+
+def test_export_then_serve_bit_identical(tmp_path):
+    zoo, trainer, batches = _trained_deepfm()
+    out_dir = str(tmp_path / "export")
+    export_model(
+        trainer,
+        out_dir,
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm_functional_api",
+        model_params="vocab_size=100",
+        chunk_rows=7,  # force multi-chunk streaming of every table
+    )
+    # Artifact layout: signature + variables + one file per table.
+    sig = json.loads((tmp_path / "export" / "signature.json").read_text())
+    assert sig["format"].startswith("elasticdl_tpu_serving/")
+    assert len(sig["tables"]) >= 1
+    for meta in sig["tables"]:
+        assert os.path.exists(os.path.join(out_dir, meta["file"]))
+
+    served = load_for_serving(out_dir)
+    feats, _ = batches[0]
+    # vs the trainer's mesh-jitted eval: numerically equivalent (XLA
+    # reduction order differs between the 8-device program and the
+    # single-host serving apply, so exact bits can't match).
+    expected = trainer.eval_step(feats)
+    got = np.asarray(served.predict(feats))
+    np.testing.assert_allclose(np.asarray(expected), got, rtol=1e-5)
+    # Serving is deterministic: repeat predictions are bit-identical.
+    np.testing.assert_array_equal(got, np.asarray(served.predict(feats)))
+
+    # Logical [vocab, dim] view for external consumers.
+    logical = served.logical_tables()
+    for meta in sig["tables"]:
+        assert logical[meta["key"]].shape == (
+            meta["vocab_size"],
+            meta["dim"],
+        )
+
+
+def test_serving_in_fresh_process(tmp_path):
+    """The artifact is self-contained: a brand-new interpreter (no trainer,
+    no mesh) loads it and predicts BIT-IDENTICALLY to in-process serving."""
+    zoo, trainer, batches = _trained_deepfm(steps=2)
+    out_dir = str(tmp_path / "export")
+    export_model(
+        trainer,
+        out_dir,
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm_functional_api",
+        model_params="vocab_size=100",
+    )
+    feats, _ = batches[0]
+    expected = np.asarray(load_for_serving(out_dir).predict(feats))
+    np.savez(tmp_path / "feats.npz", **feats)
+
+    script = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize may force TPU
+import numpy as np
+from elasticdl_tpu.serving import load_for_serving
+served = load_for_serving({out_dir!r})
+feats = dict(np.load({str(tmp_path / 'feats.npz')!r}))
+out = np.asarray(served.predict(feats))
+np.save({str(tmp_path / 'out.npy')!r}, out)
+"""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "ELASTICDL_FORCE_PLATFORM": "cpu",
+    }
+    subprocess.run(
+        [sys.executable, "-c", script],
+        check=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+        timeout=300,
+    )
+    got = np.load(tmp_path / "out.npy")
+    np.testing.assert_array_equal(expected, got)
