@@ -185,6 +185,22 @@ class ShardedCheckpointSaver(CheckpointSaver):
             self._entry_index(step).get(name, [])
         )
 
+    def release(self, step: int):
+        """Drop the cached entry index (and close its npz handles) once a
+        restore is complete — the saver object outlives the restore."""
+        index = self._index_cache.pop(step, None)
+        if not index:
+            return
+        closed = set()
+        for entries in index.values():
+            for _lo, _hi, npz, _key in entries:
+                if id(npz) not in closed:
+                    closed.add(id(npz))
+                    try:
+                        npz.close()
+                    except Exception:
+                        pass
+
     def load_array(self, step: int, name: str, sharding) -> jax.Array:
         """Materialize one sharded array under the CURRENT world's
         `sharding` — each process reads only the row intervals its local
@@ -240,12 +256,23 @@ class RowReader:
 
     def __init__(self, step_dir: str, name: str):
         self._entries = build_entry_index(step_dir).get(name, [])
+        self._decoded: Dict[Tuple[int, str], np.ndarray] = {}
 
     @classmethod
     def from_entries(cls, entries: List) -> "RowReader":
         reader = cls.__new__(cls)
         reader._entries = entries
+        reader._decoded = {}
         return reader
+
+    def _entry_data(self, npz, key: str) -> np.ndarray:
+        # npz[key] re-reads the full stored entry from disk every time;
+        # one restore calls read() once per local device, so cache the
+        # decoded entry for this reader's lifetime (one load_array call).
+        cache_key = (id(npz), key)
+        if cache_key not in self._decoded:
+            self._decoded[cache_key] = npz[key]
+        return self._decoded[cache_key]
 
     def read(self, lo: int, hi: int) -> np.ndarray:
         parts = []
@@ -258,7 +285,7 @@ class RowReader:
                     f"Checkpoint rows [{cursor}, {e_lo}) missing "
                     f"(requested [{lo}, {hi}))"
                 )
-            data = npz[key]
+            data = self._entry_data(npz, key)
             parts.append(data[cursor - e_lo : min(hi, e_hi) - e_lo])
             cursor = min(hi, e_hi)
             if cursor >= hi:

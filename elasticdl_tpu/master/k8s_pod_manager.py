@@ -182,13 +182,21 @@ class KubernetesPodManager(ElasticWorkerManager):
                 # them and nothing would ever prune them again.
                 if name in tracked or name in self._pod_states:
                     self._apply_pod_locked(pod, authoritative=True)
+            now = time.time()
+            grace = max(60.0, self._pod_startup_timeout_s)
             for name in list(self._pod_states):
                 if name in listed:
                     continue
                 if name in tracked:
                     # Vanished while the watch was down: surfaces as churn.
                     self._pod_states[name].deleted = True
-                else:
+                elif now - self._created_at.get(name, 0.0) > grace:
+                    # Old untracked leftovers only: a pod launched moments
+                    # ago may not be in _handles/_probe_handles yet (its
+                    # launch is still returning) and may predate the list
+                    # snapshot — pruning it would blind polling to it
+                    # forever.  Teardown prunes the normal case; this is
+                    # the leak backstop.
                     self._pod_states.pop(name)
                     self._we_deleted.discard(name)
                     self._created_at.pop(name, None)
@@ -287,32 +295,53 @@ class KubernetesPodManager(ElasticWorkerManager):
                 self._created_at[name] = time.time()
             try:
                 created = self._create_pod_replacing(manifest, name)
-                with self._state_lock:
-                    # Pin the created uid.  If events for THIS uid already
-                    # flowed into the placeholder, keep them (replacing
-                    # would discard a Running that may never repeat); if
-                    # the placeholder was polluted by a stale namesake —
-                    # e.g. the 409-replace path let the old pod's DELETED
-                    # mark the unpinned state deleted, which nothing ever
-                    # clears — install a fresh state for the new uid.
-                    uid = (created.get("metadata") or {}).get("uid", "")
-                    existing = self._pod_states.get(name)
-                    if existing is not None and existing.uid == uid:
-                        existing.deleted = False
-                    else:
-                        fresh = _PodState(uid=uid)
-                        fresh.phase = pod_phase(created)
-                        self._pod_states[name] = fresh
+                self._pin_created_uid(name, created)
             except ApiError as e:
                 # Leave the handle in place; poll will surface the failure
                 # as churn and the budget decides what happens next.
                 logger.error("Creating pod %s failed: %s", name, e)
                 with self._state_lock:
-                    self._pod_states[name].phase = "Failed"
-                    self._pod_states[name].exit_code = 1
+                    state = self._pod_states.setdefault(name, _PodState())
+                    state.phase = "Failed"
+                    state.exit_code = 1
             handles.append(PodHandle(wid, name))
             logger.info("Created worker pod %s", name)
         return handles
+
+    def _pin_created_uid(self, name: str, created: dict):
+        """Bind the cache entry to the uid we just created.  Events may
+        already have flowed into the placeholder — some for THIS uid
+        (keep them: a Running may never repeat), some from a stale
+        namesake whose DELETED landed while uid was unpinned.  A deleted
+        flag at pin time is therefore ambiguous; resolve it against the
+        API server: if the pod exists with our uid, the flag was the
+        namesake's — clear it; if the pod is truly gone, keep it (churn).
+        """
+        uid = (created.get("metadata") or {}).get("uid", "")
+        with self._state_lock:
+            existing = self._pod_states.get(name)
+            if existing is None or (existing.uid and existing.uid != uid):
+                fresh = _PodState(uid=uid)
+                fresh.phase = pod_phase(created)
+                self._pod_states[name] = fresh
+                return
+            existing.uid = uid
+            ambiguous = existing.deleted
+        if not ambiguous:
+            return
+        try:
+            current = self._client.get_pod(name)
+        except ApiError:
+            return  # leave deleted: worst case a spurious churn, not a hang
+        if (
+            current is not None
+            and (current.get("metadata") or {}).get("uid", "") == uid
+        ):
+            with self._state_lock:
+                state = self._pod_states.get(name)
+                if state is not None and state.uid == uid:
+                    state.deleted = False
+                    self._apply_pod_locked(current, authoritative=True)
 
     def _create_pod_replacing(self, manifest: dict, name: str) -> dict:
         """Create, tolerating one 409 AlreadyExists by deleting the stale

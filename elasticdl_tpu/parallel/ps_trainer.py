@@ -545,6 +545,8 @@ class ShardedEmbeddingTrainer:
                 f"Checkpoint table {k} shape {v.shape} != model "
                 f"{template.tables[k].shape} (vocab/dim changed?)"
             )
+        if hasattr(saver, "release"):
+            saver.release(step)  # close shard-file handles; restore done
         self._host_step = int(np.asarray(dense["step"]))
         logger.info(
             "Restored sharded checkpoint at step %d (%d tables)",
@@ -587,7 +589,10 @@ class ShardedEmbeddingTrainer:
 
     def get_variables_numpy(self) -> dict:
         """Flat {path: logical np.ndarray} — packed tables are unpacked to
-        their [vocab, dim] shape (the export/serving view)."""
+        their [vocab, dim] shape (the export/serving view).  COLLECTIVE in
+        a multi-process world: tables span processes, so materializing
+        them is an allgather every rank must join (device_get alone raises
+        on non-addressable shards)."""
         if self._state is None:
             return {}
         state = self._state
@@ -595,7 +600,9 @@ class ShardedEmbeddingTrainer:
         merged = self._merge_params(
             jax.device_get(state.params),
             {
-                k: np.asarray(pk.unpack(self._table_specs[k], jax.device_get(v)))
+                k: np.asarray(
+                    pk.unpack(self._table_specs[k], shd.gather_to_host(v))
+                )
                 for k, v in state.tables.items()
             },
         )

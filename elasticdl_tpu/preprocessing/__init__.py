@@ -1,0 +1,9 @@
+from elasticdl_tpu.preprocessing.layers import (  # noqa: F401
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    Normalizer,
+    RoundIdentity,
+    to_padded_ids,
+)

@@ -1,0 +1,265 @@
+"""Feature-preprocessing layers.
+
+Parity: elasticdl_preprocessing/layers in the reference (~1500 LoC of
+Keras layers: Hashing, IndexLookup, Discretization, Normalizer,
+ConcatenateWithOffset, RoundIdentity, ToSparse) — the transforms CTR
+models need to consume raw strings/floats instead of pre-encoded ids.
+
+TPU-first split: a TPU program cannot hold strings, so each transform
+declares where it runs —
+
+- HOST transforms (Hashing over strings, IndexLookup, to_padded_ids) run
+  in the data pipeline (dataset_fn / reader) on numpy, producing the
+  fixed-shape integer/float tensors the compiled model consumes.
+- DEVICE transforms (Discretization, Normalizer, RoundIdentity,
+  ConcatenateWithOffset, Hashing over ints) are pure jnp functions that
+  trace cleanly under jit inside the model.
+
+Every transform is ONE callable usable with both numpy and jax.numpy
+inputs with identical semantics, so the exact object used in training's
+dataset_fn is reusable at serving time (train==serve consistency, the
+property the reference's Keras-layer design exists for — asserted
+leaf-by-leaf in tests/test_preprocessing.py).
+
+The reference's ToSparse (dense -> SparseTensor for variable-length
+categorical features) has no TPU analogue — XLA wants static shapes — so
+its job is done by `to_padded_ids`: ragged id lists become a fixed-width
+dense block padded with -1, which `layers.Embedding` already treats as
+"no row" (negative-id masking).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+ArrayLike = Union[np.ndarray, "jax.Array"]  # noqa: F821
+
+
+def _np_like(x):
+    """jnp for traced/device values, np otherwise — keeps one code path
+    valid in both the host pipeline and a jitted model."""
+    import jax.numpy as jnp
+
+    return jnp if type(x).__module__.startswith("jax") else np
+
+
+def _mix32(h):
+    """Murmur3 fmix32 finalizer — identical bit-for-bit in numpy and jnp
+    uint32 arithmetic (no uint64, which jax disables without x64)."""
+    xp = _np_like(h)
+    h = xp.asarray(h).astype(xp.uint32)
+    h = (h ^ (h >> 16)) * xp.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) * xp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+class Hashing:
+    """Deterministic hash-bucketing: x -> [0, num_bins).
+
+    Parity: elasticdl_preprocessing Hashing (reference hashes with
+    FarmHash64 via tf.strings.to_hash_bucket_fast).  Strings hash on HOST
+    (md5-based, stable across processes and restarts — Python's builtin
+    hash() is salted and must never be used here); integers hash with a
+    murmur-finalizer that runs identically on host numpy and inside jit.
+    """
+
+    def __init__(self, num_bins: int, salt: int = 0):
+        if num_bins <= 0:
+            raise ValueError("num_bins must be positive")
+        self.num_bins = num_bins
+        self.salt = salt
+
+    def _hash_str(self, s: str) -> int:
+        digest = hashlib.md5(
+            (f"{self.salt}\x00" + s).encode("utf-8")
+        ).digest()
+        return int.from_bytes(digest[:8], "little") % self.num_bins
+
+    def __call__(self, x: ArrayLike) -> ArrayLike:
+        arr = x if hasattr(x, "dtype") else np.asarray(x)
+        if hasattr(arr, "dtype") and arr.dtype.kind in ("U", "S", "O"):
+            flat = np.asarray(arr).ravel()
+            out = np.fromiter(
+                (self._hash_str(str(s)) for s in flat),
+                count=flat.size,
+                dtype=np.int32,
+            )
+            return out.reshape(np.shape(arr))
+        xp = _np_like(arr)
+        h = _mix32(
+            xp.asarray(arr).astype(xp.uint32) ^ xp.uint32(self.salt)
+        )
+        return (h % xp.uint32(self.num_bins)).astype(xp.int32)
+
+
+class IndexLookup:
+    """Vocabulary lookup: token -> index; unknown tokens map to OOV ids.
+
+    Parity: elasticdl_preprocessing IndexLookup.  Layout matches the
+    reference: indices [0, num_oov_indices) are OOV buckets (hashed when
+    more than one), vocabulary tokens follow.  HOST transform (strings).
+    """
+
+    def __init__(
+        self,
+        vocabulary: Sequence[str],
+        num_oov_indices: int = 1,
+    ):
+        if num_oov_indices < 0:
+            raise ValueError("num_oov_indices must be >= 0")
+        self.vocabulary: List[str] = list(vocabulary)
+        self.num_oov_indices = num_oov_indices
+        self._table: Dict[str, int] = {
+            token: i + num_oov_indices
+            for i, token in enumerate(self.vocabulary)
+        }
+        self._oov_hash = Hashing(max(1, num_oov_indices), salt=1)
+
+    @property
+    def vocab_size(self) -> int:
+        """Total id space including OOV buckets (embedding input_dim)."""
+        return len(self.vocabulary) + self.num_oov_indices
+
+    def _lookup_one(self, token: str) -> int:
+        idx = self._table.get(token)
+        if idx is not None:
+            return idx
+        if self.num_oov_indices == 0:
+            raise KeyError(f"Token {token!r} not in vocabulary (no OOV)")
+        if self.num_oov_indices == 1:
+            return 0
+        return int(self._oov_hash(np.asarray([token], object))[0])
+
+    def __call__(self, x: ArrayLike) -> np.ndarray:
+        arr = np.asarray(x)
+        flat = arr.ravel()
+        out = np.fromiter(
+            (self._lookup_one(str(s)) for s in flat),
+            count=flat.size,
+            dtype=np.int32,
+        )
+        return out.reshape(arr.shape)
+
+
+class Discretization:
+    """Bucketize by boundaries: value -> bin index in [0, len(bins)].
+
+    Parity: elasticdl_preprocessing Discretization.  DEVICE transform
+    (searchsorted lowers to XLA); same call works on host numpy.
+    """
+
+    def __init__(self, bin_boundaries: Sequence[float]):
+        self.bin_boundaries = [float(b) for b in bin_boundaries]
+        if sorted(self.bin_boundaries) != self.bin_boundaries:
+            raise ValueError("bin_boundaries must be ascending")
+
+    @property
+    def num_bins(self) -> int:
+        return len(self.bin_boundaries) + 1
+
+    def __call__(self, x: ArrayLike) -> ArrayLike:
+        xp = _np_like(x)
+        bounds = xp.asarray(self.bin_boundaries, xp.float32)
+        return xp.searchsorted(
+            bounds, xp.asarray(x, xp.float32), side="right"
+        ).astype(xp.int32)
+
+
+class Normalizer:
+    """(x - subtract) / divide, elementwise.
+
+    Parity: elasticdl_preprocessing Normalizer (the standardize/min-max
+    scaling layer).  DEVICE transform; fuses into adjacent XLA ops.
+    """
+
+    def __init__(self, subtract: float = 0.0, divide: float = 1.0):
+        if divide == 0.0:
+            raise ValueError("divide must be nonzero")
+        self.subtract = float(subtract)
+        self.divide = float(divide)
+
+    @classmethod
+    def from_stats(cls, mean: float, std: float) -> "Normalizer":
+        return cls(subtract=mean, divide=std if std else 1.0)
+
+    def __call__(self, x: ArrayLike) -> ArrayLike:
+        xp = _np_like(x)
+        x = xp.asarray(x, xp.float32)
+        return (x - xp.float32(self.subtract)) / xp.float32(self.divide)
+
+
+class RoundIdentity:
+    """Round a numeric feature into an integer id in [0, max_value).
+
+    Parity: elasticdl_preprocessing RoundIdentity (numeric -> embedding id
+    without binning).  DEVICE transform.
+    """
+
+    def __init__(self, max_value: int):
+        if max_value <= 0:
+            raise ValueError("max_value must be positive")
+        self.max_value = int(max_value)
+
+    def __call__(self, x: ArrayLike) -> ArrayLike:
+        xp = _np_like(x)
+        ids = xp.round(xp.asarray(x, xp.float32))
+        return xp.clip(ids, 0, self.max_value - 1).astype(xp.int32)
+
+
+class ConcatenateWithOffset:
+    """Concatenate id columns, offsetting each into a disjoint id range —
+    the shared-embedding-table trick (one [sum(sizes), dim] table serves
+    every categorical feature with a single lookup).
+
+    Parity: elasticdl_preprocessing ConcatenateWithOffset.  DEVICE
+    transform.  Negative ids (padding, see to_padded_ids) stay negative:
+    offsetting a pad row would turn "no row" into a real row.
+    """
+
+    def __init__(self, id_space_sizes: Sequence[int]):
+        self.id_space_sizes = [int(s) for s in id_space_sizes]
+        offsets = np.concatenate(
+            [[0], np.cumsum(self.id_space_sizes[:-1])]
+        ).astype(np.int32)
+        self.offsets = offsets
+
+    @property
+    def total_id_space(self) -> int:
+        return int(sum(self.id_space_sizes))
+
+    def __call__(self, columns: Iterable[ArrayLike]) -> ArrayLike:
+        columns = list(columns)
+        if len(columns) != len(self.id_space_sizes):
+            raise ValueError(
+                f"Expected {len(self.id_space_sizes)} columns, "
+                f"got {len(columns)}"
+            )
+        xp = _np_like(columns[0])
+        shifted = []
+        for column, offset in zip(columns, self.offsets):
+            ids = xp.asarray(column, xp.int32)
+            if ids.ndim == 1:
+                ids = ids[:, None]
+            shifted.append(xp.where(ids >= 0, ids + xp.int32(offset), ids))
+        return xp.concatenate(shifted, axis=-1)
+
+
+def to_padded_ids(
+    rows: Sequence[Sequence[int]],
+    max_len: int,
+    pad_id: int = -1,
+    dtype=np.int32,
+) -> np.ndarray:
+    """Ragged id lists -> fixed [len(rows), max_len] dense block padded
+    with `pad_id` (the reference ToSparse's job, reshaped for XLA's
+    static-shape world; layers.Embedding masks ids < 0).  Overlong rows
+    truncate — deterministically, keeping the first max_len ids."""
+    out = np.full((len(rows), max_len), pad_id, dtype=dtype)
+    for i, row in enumerate(rows):
+        take = min(len(row), max_len)
+        if take:
+            out[i, :take] = np.asarray(row[:take], dtype=dtype)
+    return out

@@ -152,3 +152,74 @@ def synthetic_classification_reader(
     )
     labels = np.argmax(logits, axis=1).astype(np.int32)
     return NumpyDataReader(features, labels, shard_name=shard_name)
+
+
+# Census raw-feature vocabularies (reference: the census dataset the
+# elasticdl_preprocessing layers were built for — strings + floats).
+CENSUS_EDUCATION = [
+    "Bachelors", "HS-grad", "11th", "Masters", "9th", "Some-college",
+    "Assoc-acdm", "Assoc-voc", "7th-8th", "Doctorate", "Prof-school",
+    "5th-6th", "10th", "1st-4th", "Preschool", "12th",
+]
+CENSUS_WORKCLASS = [
+    "Private", "Self-emp-not-inc", "Self-emp-inc", "Federal-gov",
+    "Local-gov", "State-gov", "Without-pay", "Never-worked",
+]
+CENSUS_OCCUPATIONS = [
+    f"occupation-{i}" for i in range(40)  # high-cardinality: gets hashed
+]
+
+
+def synthetic_census_reader(n: int = 4096, seed: int = 0,
+                            shard_name: str = "census-synth"):
+    """Census-shaped RAW records: strings + unscaled floats, exactly what
+    the preprocessing layers exist to consume.  A record is
+    ({'age': f32, 'capital_gain': f32, 'hours_per_week': f32,
+      'education': str, 'workclass': str, 'occupation': str}, label) with
+    a label genuinely dependent on every feature family, so training only
+    learns if the preprocessing (lookup/hash/discretize/normalize) wires
+    the features through correctly."""
+    rng = np.random.default_rng(seed)
+    age = rng.uniform(17, 90, size=n).astype(np.float32)
+    gain = np.abs(rng.normal(3000, 8000, size=n)).astype(np.float32)
+    hours = rng.uniform(1, 99, size=n).astype(np.float32)
+    edu_idx = rng.integers(0, len(CENSUS_EDUCATION), size=n)
+    work_idx = rng.integers(0, len(CENSUS_WORKCLASS), size=n)
+    occ_idx = rng.integers(0, len(CENSUS_OCCUPATIONS), size=n)
+
+    w_edu = rng.standard_normal(len(CENSUS_EDUCATION)).astype(np.float32)
+    w_work = rng.standard_normal(len(CENSUS_WORKCLASS)).astype(np.float32)
+    w_occ = rng.standard_normal(len(CENSUS_OCCUPATIONS)).astype(np.float32)
+    logits = (
+        w_edu[edu_idx]
+        + w_work[work_idx]
+        + w_occ[occ_idx]
+        + 0.03 * (hours - 40.0)
+        + 0.02 * (age - 40.0)
+        + gain / 20000.0
+    )
+    labels = (logits > np.median(logits)).astype(np.int32)
+    records = [
+        (
+            {
+                "age": age[i],
+                "capital_gain": gain[i],
+                "hours_per_week": hours[i],
+                "education": CENSUS_EDUCATION[edu_idx[i]],
+                "workclass": CENSUS_WORKCLASS[work_idx[i]],
+                "occupation": CENSUS_OCCUPATIONS[occ_idx[i]],
+            },
+            labels[i],
+        )
+        for i in range(n)
+    ]
+
+    class _CensusReader(AbstractDataReader):
+        def create_shards(self):
+            return {shard_name: len(records)}
+
+        def read_records(self, task):
+            for i in range(task.start, min(task.end, len(records))):
+                yield records[i]
+
+    return _CensusReader()

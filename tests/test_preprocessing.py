@@ -1,0 +1,249 @@
+"""Preprocessing-layer tests.
+
+Mirrors the reference's elasticdl_preprocessing/tests layer-by-layer
+golden tests, plus the properties the TPU split adds: device transforms
+must be bit-identical between host numpy and jitted jnp execution, and
+the census model must train end-to-end from RAW strings/floats through
+the full transform stack.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.preprocessing import (
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    Normalizer,
+    RoundIdentity,
+    to_padded_ids,
+)
+
+
+class TestHashing:
+    def test_string_hash_is_stable_and_in_range(self):
+        layer = Hashing(num_bins=16)
+        x = np.asarray(["cat", "dog", "cat", ""], object)
+        out = layer(x)
+        assert out.dtype == np.int32
+        assert out[0] == out[2]  # deterministic
+        assert ((out >= 0) & (out < 16)).all()
+        # Stable across instances AND processes (md5-based, not builtin
+        # hash() which is salted per interpreter).
+        np.testing.assert_array_equal(out, Hashing(num_bins=16)(x))
+
+    def test_salt_changes_mapping(self):
+        x = np.asarray([f"tok{i}" for i in range(64)], object)
+        a, b = Hashing(num_bins=64)(x), Hashing(num_bins=64, salt=1)(x)
+        assert (a != b).any()
+
+    def test_int_hash_host_equals_device(self):
+        layer = Hashing(num_bins=101)
+        ids = np.arange(0, 5000, 7, dtype=np.int32)
+        host = layer(ids)
+        device = np.asarray(jax.jit(layer)(jnp.asarray(ids)))
+        np.testing.assert_array_equal(host, device)
+        assert ((host >= 0) & (host < 101)).all()
+
+    def test_rejects_bad_bins(self):
+        with pytest.raises(ValueError):
+            Hashing(0)
+
+
+class TestIndexLookup:
+    def test_vocab_and_oov(self):
+        layer = IndexLookup(["a", "b", "c"], num_oov_indices=1)
+        out = layer(np.asarray([["a", "zzz"], ["c", "b"]], object))
+        np.testing.assert_array_equal(out, [[1, 0], [3, 2]])
+        assert layer.vocab_size == 4
+
+    def test_multi_oov_stable_and_in_range(self):
+        layer = IndexLookup(["a"], num_oov_indices=4)
+        unknowns = np.asarray([f"u{i}" for i in range(32)], object)
+        out = layer(unknowns)
+        assert ((out >= 0) & (out < 4)).all()
+        np.testing.assert_array_equal(out, layer(unknowns))
+        assert layer(np.asarray(["a"]))[0] == 4
+
+    def test_no_oov_raises(self):
+        layer = IndexLookup(["a"], num_oov_indices=0)
+        with pytest.raises(KeyError):
+            layer(np.asarray(["b"]))
+
+
+class TestDiscretization:
+    def test_golden(self):
+        layer = Discretization([0.0, 1.0, 10.0])
+        out = layer(np.asarray([-5.0, 0.0, 0.5, 1.0, 3.0, 99.0]))
+        np.testing.assert_array_equal(out, [0, 1, 1, 2, 2, 3])
+        assert layer.num_bins == 4
+
+    def test_host_equals_device(self):
+        layer = Discretization([-1.0, 0.0, 2.5])
+        x = np.linspace(-3, 3, 31).astype(np.float32)
+        np.testing.assert_array_equal(
+            layer(x), np.asarray(jax.jit(layer)(jnp.asarray(x)))
+        )
+
+    def test_unsorted_raises(self):
+        with pytest.raises(ValueError):
+            Discretization([1.0, 0.0])
+
+
+class TestNormalizer:
+    def test_golden(self):
+        layer = Normalizer(subtract=10.0, divide=2.0)
+        np.testing.assert_allclose(
+            layer(np.asarray([10.0, 14.0])), [0.0, 2.0]
+        )
+
+    def test_from_stats_and_zero_div(self):
+        layer = Normalizer.from_stats(mean=5.0, std=0.0)
+        np.testing.assert_allclose(layer(np.asarray([6.0])), [1.0])
+        with pytest.raises(ValueError):
+            Normalizer(divide=0.0)
+
+    def test_host_equals_device(self):
+        layer = Normalizer(3.0, 7.0)
+        x = np.linspace(-5, 5, 17).astype(np.float32)
+        # allclose, not bit-equal: XLA strength-reduces the division to a
+        # reciprocal multiply (1-ulp difference); the integer-producing
+        # transforms (Hashing/Discretization/RoundIdentity) stay exact.
+        np.testing.assert_allclose(
+            layer(x),
+            np.asarray(jax.jit(layer)(jnp.asarray(x))),
+            rtol=1e-6,
+        )
+
+
+class TestRoundIdentity:
+    def test_golden_and_clip(self):
+        layer = RoundIdentity(max_value=10)
+        out = layer(np.asarray([0.4, 0.6, 9.7, 50.0, -3.0]))
+        np.testing.assert_array_equal(out, [0, 1, 10 - 1, 9, 0])
+
+    def test_host_equals_device(self):
+        layer = RoundIdentity(100)
+        x = np.linspace(-10, 150, 41).astype(np.float32)
+        np.testing.assert_array_equal(
+            layer(x), np.asarray(jax.jit(layer)(jnp.asarray(x)))
+        )
+
+
+class TestConcatenateWithOffset:
+    def test_offsets_disjoint_id_spaces(self):
+        layer = ConcatenateWithOffset([4, 8, 2])
+        out = layer(
+            [
+                np.asarray([0, 3], np.int32),
+                np.asarray([0, 7], np.int32),
+                np.asarray([1, 0], np.int32),
+            ]
+        )
+        np.testing.assert_array_equal(out, [[0, 4, 13], [3, 11, 12]])
+        assert layer.total_id_space == 14
+
+    def test_padding_ids_stay_negative(self):
+        layer = ConcatenateWithOffset([4, 4])
+        out = layer(
+            [np.asarray([[-1, 2]], np.int32), np.asarray([[1, -1]], np.int32)]
+        )
+        np.testing.assert_array_equal(out, [[-1, 2, 5, -1]])
+
+    def test_column_count_mismatch(self):
+        with pytest.raises(ValueError):
+            ConcatenateWithOffset([4])([np.zeros(1), np.zeros(1)])
+
+    def test_host_equals_device(self):
+        layer = ConcatenateWithOffset([16, 16])
+        cols = [
+            np.arange(8, dtype=np.int32),
+            np.arange(8, dtype=np.int32)[::-1].copy(),
+        ]
+        host = layer(cols)
+        device = np.asarray(
+            jax.jit(lambda a, b: layer([a, b]))(*map(jnp.asarray, cols))
+        )
+        np.testing.assert_array_equal(host, device)
+
+
+def test_to_padded_ids():
+    out = to_padded_ids([[1, 2, 3], [], [7, 8, 9, 10]], max_len=3)
+    np.testing.assert_array_equal(
+        out, [[1, 2, 3], [-1, -1, -1], [7, 8, 9]]
+    )
+    assert out.dtype == np.int32
+
+
+# ---------------------------------------------------------------------------
+# Census model: raw strings/floats through the whole stack.
+# ---------------------------------------------------------------------------
+
+
+def _census_batches(n=64, mb=16, seed=0):
+    from elasticdl_tpu.data.dataset import Dataset, _stack
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from model_zoo import datasets
+    from model_zoo.census import census_wide_deep as zoo
+
+    reader = datasets.synthetic_census_reader(n=n, seed=seed)
+    task = pb.Task(task_id=1, shard_name="s", start=0, end=n)
+    records = list(
+        zoo.dataset_fn(
+            Dataset.from_generator(lambda: reader.read_records(task)),
+            "training",
+            None,
+        )
+    )
+    for i in range(0, n, mb):
+        yield _stack(records[i : i + mb])
+
+
+def test_census_model_trains_from_raw_features():
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+    from model_zoo.census import census_wide_deep as zoo
+
+    mesh = build_mesh(MeshConfig())
+    trainer = ShardedEmbeddingTrainer(
+        zoo.custom_model(),
+        zoo.loss,
+        zoo.optimizer(),
+        mesh,
+        embedding_optimizer=zoo.embedding_optimizer(),
+    )
+    losses = []
+    for epoch in range(8):
+        for feats, labels in _census_batches(n=64, mb=16, seed=epoch % 2):
+            losses.append(float(trainer.train_step(feats, labels)))
+    assert losses[-1] < losses[0] * 0.9, f"no learning: {losses[:2]} -> {losses[-2:]}"
+    feats, labels = next(_census_batches(n=16, mb=16, seed=9))
+    out = trainer.eval_step(feats)
+    metrics = {
+        name: fn(np.asarray(out), labels)
+        for name, fn in zoo.eval_metrics_fn().items()
+    }
+    assert 0.0 <= metrics["auc"] <= 1.0
+
+
+def test_census_train_serve_consistency():
+    """The host transforms used by dataset_fn are the same objects a
+    serving caller uses: one raw record preprocessed both ways yields
+    identical features."""
+    from model_zoo import datasets
+    from model_zoo.census import census_wide_deep as zoo
+
+    reader = datasets.synthetic_census_reader(n=4, seed=3)
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+    task = pb.Task(task_id=1, shard_name="s", start=0, end=4)
+    for raw, _label in reader.read_records(task):
+        once = zoo.preprocess_record(raw)
+        twice = zoo.preprocess_record(dict(raw))
+        for key in once:
+            np.testing.assert_array_equal(once[key], twice[key])
+        assert once["edu_id"] >= 0 and once["occ_id"] < 64
