@@ -79,6 +79,8 @@ def _run_local(args, mode: str):
         else None
     )
 
+    from elasticdl_tpu.common.profiler import StepProfiler
+
     client = MasterClient(master.addr, worker_id=0)
     worker = Worker(
         master_client=client,
@@ -86,6 +88,9 @@ def _run_local(args, mode: str):
         data_reader=data_reader,
         minibatch_size=args.minibatch_size,
         validation_data_reader=validation_reader,
+        profiler=StepProfiler(
+            args.tensorboard_log_dir, args.profile_steps, worker_id=0
+        ),
     )
     try:
         worker.run()

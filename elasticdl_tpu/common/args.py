@@ -24,6 +24,20 @@ def non_neg_int(value):
     return ivalue
 
 
+def _profile_steps_spec(value):
+    """Validate --profile_steps AT PARSE TIME (master-side): a malformed
+    spec must fail the submission, not crash-loop every worker pod until
+    the restart budget dies."""
+    if value:
+        from elasticdl_tpu.common.profiler import parse_profile_steps
+
+        try:
+            parse_profile_steps(value)
+        except ValueError as e:
+            raise argparse.ArgumentTypeError(str(e))
+    return value
+
+
 def str2bool(value):
     if isinstance(value, bool):
         return value
@@ -90,6 +104,12 @@ def add_train_arguments(parser: argparse.ArgumentParser):
     parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=3)
     parser.add_argument("--output", default="", help="Trained model output path")
     parser.add_argument("--tensorboard_log_dir", default="")
+    parser.add_argument(
+        "--profile_steps", default="", type=_profile_steps_spec,
+        help="'START,END': each worker captures a jax.profiler trace of "
+        "its training steps in [START, END) under "
+        "<tensorboard_log_dir>/profile (TensorBoard Profile plugin)",
+    )
     parser.add_argument("--task_timeout_s", type=non_neg_int, default=0)
     parser.add_argument("--use_bf16", type=str2bool, nargs="?", const=True,
                         default=True, help="Compute in bfloat16 on the MXU")
@@ -156,15 +176,27 @@ def build_worker_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _validate_cross_flags(args):
+    if getattr(args, "profile_steps", "") and not getattr(
+        args, "tensorboard_log_dir", ""
+    ):
+        raise ValueError(
+            "--profile_steps requires --tensorboard_log_dir (traces are "
+            "written under it for the TensorBoard Profile plugin)"
+        )
+
+
 def parse_master_args(argv=None):
     args, unknown = build_master_parser().parse_known_args(argv)
     _apply_log_level(args)
+    _validate_cross_flags(args)
     return args
 
 
 def parse_worker_args(argv=None):
     args, unknown = build_worker_parser().parse_known_args(argv)
     _apply_log_level(args)
+    _validate_cross_flags(args)
     return args
 
 

@@ -208,6 +208,10 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
             worker_env[key.strip()] = value
     manager = _build_worker_manager(args, master, rendezvous, worker_env)
     master.pod_manager = manager  # type: ignore[attr-defined]
+    if master.tensorboard_service is not None:
+        master.tensorboard_service.bind(
+            restarts_fn=lambda: manager.restarts_used
+        )
     progress_persister = master.progress_persister
     job_succeeded = False
     try:

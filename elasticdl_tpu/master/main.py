@@ -37,12 +37,19 @@ class Master:
     rendezvous_server: object = None
     data_reader: object = None
     progress_persister: object = None
+    tensorboard_service: object = None
 
     @property
     def addr(self) -> str:
         return f"localhost:{self.port}"
 
     def stop(self):
+        if self.tensorboard_service is not None:
+            try:
+                self.tensorboard_service.close()
+            except Exception:
+                logger.exception("TensorBoard close failed")
+            self.tensorboard_service = None
         if self.progress_persister is not None:
             try:
                 self.progress_persister.stop()
@@ -121,12 +128,21 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
             task_timeout_s=args.task_timeout_s,
         )
 
+    tensorboard_service = None
+    if getattr(args, "tensorboard_log_dir", ""):
+        from elasticdl_tpu.master.tensorboard_service import TensorBoardService
+
+        tensorboard_service = TensorBoardService(
+            args.tensorboard_log_dir, task_manager=task_manager
+        )
+
     evaluation_service = None
     if model_spec.eval_metrics_fn is not None and evaluation_shards:
         evaluation_service = EvaluationService(
             task_manager,
             eval_metrics_fn=model_spec.eval_metrics_fn,
             evaluation_steps=args.evaluation_steps,
+            tensorboard_service=tensorboard_service,
         )
 
     servicer = MasterServicer(
@@ -134,6 +150,11 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
         evaluation_service=evaluation_service,
         rendezvous_server=rendezvous_server,
     )
+    if tensorboard_service is not None:
+        tensorboard_service.bind(
+            model_version_fn=lambda: servicer.model_version
+        )
+        tensorboard_service.start()
     if evaluation_service is not None and training_shards:
         # Always run a final evaluation when training tasks finish.
         task_manager.add_tasks_done_callback(
@@ -163,6 +184,7 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
         rendezvous_server=rendezvous_server,
         data_reader=training_reader,
         progress_persister=progress_persister,
+        tensorboard_service=tensorboard_service,
     )
     return master
 

@@ -147,6 +147,11 @@ class ElasticWorkerManager:
     def failed_reason(self) -> Optional[str]:
         return self._failed_reason
 
+    @property
+    def restarts_used(self) -> int:
+        with self._lock:
+            return self._restarts_used
+
     def stop(self):
         with self._lock:
             self._stopped = True
@@ -433,7 +438,7 @@ def worker_argv_from_args(args, master_addr: str) -> Callable[[int], List[str]]:
             "records_per_task", "minibatch_size", "num_epochs",
             "data_reader_params", "distribution_strategy", "log_level",
             "checkpoint_dir", "checkpoint_steps", "keep_checkpoint_max",
-            "output", "use_bf16",
+            "output", "use_bf16", "tensorboard_log_dir", "profile_steps",
         },
     )
 

@@ -1,0 +1,147 @@
+"""Master-side TensorBoard scalar service.
+
+Parity: elasticdl/python/master/tensorboard_service.py in the reference —
+the master owns one event-file writer and streams job-level scalars:
+evaluation metrics per model version (pushed by EvaluationService through
+`write_dict_to_summary`, the reference's method name) and training
+progress (model version, records/tasks finished, worker-restart count)
+sampled on a background cadence, since the master — not any worker — is
+the single stable observer of an elastic job.
+
+Writer backend: torch.utils.tensorboard's SummaryWriter (pure event-file
+protocol, no TF runtime).  Missing backend degrades to a warning, never
+a job failure — observability must not take training down.
+
+Worker-side profiling (jax.profiler traces viewable in the same
+TensorBoard under the Profile plugin) lives in common/profiler.py; this
+module is only the master's scalar plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("master.tensorboard")
+
+
+class TensorBoardService:
+    def __init__(
+        self,
+        log_dir: str,
+        task_manager=None,
+        model_version_fn: Optional[Callable[[], int]] = None,
+        restarts_fn: Optional[Callable[[], int]] = None,
+        sample_interval_s: float = 10.0,
+    ):
+        self._log_dir = log_dir
+        self._task_manager = task_manager
+        self._model_version_fn = model_version_fn
+        self._restarts_fn = restarts_fn
+        self._sample_interval_s = sample_interval_s
+        self._lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(log_dir=log_dir)
+            logger.info("TensorBoard events -> %s", log_dir)
+        except Exception:
+            logger.exception(
+                "TensorBoard writer unavailable; scalars will be dropped"
+            )
+
+    # -- write paths ----------------------------------------------------
+
+    def write_dict_to_summary(
+        self, metrics: Dict[str, float], version: int, prefix: str = "eval"
+    ):
+        """EvaluationService pushes each finalized round's metrics here
+        (reference method name/contract)."""
+        if self._writer is None:
+            return
+        with self._lock:
+            for name, value in metrics.items():
+                try:
+                    self._writer.add_scalar(
+                        f"{prefix}/{name}", float(value), int(version)
+                    )
+                except Exception:
+                    logger.exception("Dropping scalar %s", name)
+            self._writer.flush()
+
+    def write_scalar(self, tag: str, value: float, step: int):
+        if self._writer is None:
+            return
+        with self._lock:
+            try:
+                self._writer.add_scalar(tag, float(value), int(step))
+            except Exception:
+                logger.exception("Dropping scalar %s", tag)
+
+    def bind(
+        self,
+        model_version_fn: Optional[Callable[[], int]] = None,
+        restarts_fn: Optional[Callable[[], int]] = None,
+    ):
+        """Late-bind progress sources that exist only after this service
+        is constructed (servicer's model version, the pod manager's
+        restart counter)."""
+        if model_version_fn is not None:
+            self._model_version_fn = model_version_fn
+        if restarts_fn is not None:
+            self._restarts_fn = restarts_fn
+
+    # -- progress sampling ----------------------------------------------
+
+    def start(self) -> "TensorBoardService":
+        if self._writer is not None:
+            self._thread = threading.Thread(
+                target=self._sample_loop, name="tensorboard-sampler",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def _sample_progress(self):
+        version = (
+            int(self._model_version_fn()) if self._model_version_fn else 0
+        )
+        if self._task_manager is not None:
+            counts = self._task_manager.counts()
+            self.write_scalar(
+                "train/records_finished",
+                self._task_manager.finished_record_count,
+                version,
+            )
+            self.write_scalar("train/tasks_todo", counts["todo"], version)
+            self.write_scalar("train/epoch", counts["epoch"], version)
+        if self._model_version_fn is not None:
+            self.write_scalar("train/model_version", version, version)
+        if self._restarts_fn is not None:
+            self.write_scalar(
+                "train/worker_restarts", self._restarts_fn(), version
+            )
+
+    def _sample_loop(self):
+        while not self._stop_event.wait(self._sample_interval_s):
+            try:
+                self._sample_progress()
+            except Exception:
+                logger.exception("TensorBoard progress sample failed")
+
+    def close(self):
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._writer is not None:
+            try:
+                self._sample_progress()  # final datapoint at job end
+                self._writer.flush()
+                self._writer.close()
+            except Exception:
+                pass

@@ -51,6 +51,7 @@ class CollectiveWorker:
         wait_sleep_s: float = 0.5,
         validation_data_reader=None,
         prediction_data_reader=None,
+        profiler=None,
     ):
         self._mc = master_client
         self._spec = model_spec
@@ -66,6 +67,7 @@ class CollectiveWorker:
         self._wait_sleep_s = wait_sleep_s
         self._last_reported_version = 0
         self._last_ckpt_step = 0
+        self._profiler = profiler
         # Task-type -> reader: evaluation/prediction shards address their
         # own data sources when configured.
         self._readers = {
@@ -136,6 +138,8 @@ class CollectiveWorker:
             self._run_task_loop()
         finally:
             heartbeat.stop()
+            if self._profiler is not None:
+                self._profiler.stop()
 
     def _run_task_loop(self):
         self.restore_from_checkpoint()
@@ -238,6 +242,12 @@ class CollectiveWorker:
             nonlocal batch_count, record_count, pending, pending_real, last_loss
             if not pending:
                 return
+            if self._profiler is not None:
+                # Pre-dispatch: a K-step fused window traces whole (it
+                # cannot stop mid-device-call); boundaries round outward.
+                self._profiler.before_steps(
+                    self._trainer.step, len(pending)
+                )
             if len(pending) == self.WINDOW and hasattr(
                 self._trainer, "stage_window"
             ):
@@ -252,6 +262,8 @@ class CollectiveWorker:
             batch_count += len(pending)
             record_count += pending_real
             pending, pending_real = [], 0
+            if self._profiler is not None:
+                self._profiler.after_steps(self._trainer.step)
             self._report_version_if_due()
             self._maybe_checkpoint()
 

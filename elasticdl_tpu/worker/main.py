@@ -52,6 +52,8 @@ def main(argv=None):
             validation_reader, prediction_reader,
         )
     else:
+        from elasticdl_tpu.common.profiler import StepProfiler
+
         worker = Worker(
             master_client=client,
             model_spec=model_spec,
@@ -59,6 +61,9 @@ def main(argv=None):
             minibatch_size=args.minibatch_size,
             validation_data_reader=validation_reader,
             prediction_data_reader=prediction_reader,
+            profiler=StepProfiler(
+                args.tensorboard_log_dir, args.profile_steps, args.worker_id
+            ),
         )
     worker.run()
     if args.output and "training" in args.job_type:
@@ -121,6 +126,8 @@ def _build_collective_worker(
             saver = CheckpointSaver(
                 args.checkpoint_dir, keep_max=args.keep_checkpoint_max
             )
+    from elasticdl_tpu.common.profiler import StepProfiler
+
     return CollectiveWorker(
         master_client=client,
         model_spec=model_spec,
@@ -132,6 +139,9 @@ def _build_collective_worker(
         checkpoint_steps=args.checkpoint_steps,
         validation_data_reader=validation_reader,
         prediction_data_reader=prediction_reader,
+        profiler=StepProfiler(
+            args.tensorboard_log_dir, args.profile_steps, args.worker_id
+        ),
     )
 
 

@@ -37,6 +37,7 @@ class Worker:
         max_consecutive_task_failures: int = 10,
         validation_data_reader=None,
         prediction_data_reader=None,
+        profiler=None,
     ):
         self._mc = master_client
         self._spec = model_spec
@@ -65,6 +66,7 @@ class Worker:
         self._wait_sleep_s = wait_sleep_s
         self._max_consecutive_failures = max_consecutive_task_failures
         self._last_reported_version = 0
+        self._profiler = profiler
 
     @property
     def trainer(self) -> Trainer:
@@ -74,6 +76,15 @@ class Worker:
 
     def run(self):
         """Main loop: pull tasks until the master says the job is done."""
+        try:
+            self._run_inner()
+        finally:
+            # In finally: an aborting worker must still flush an in-flight
+            # profiler trace — it's most needed exactly then.
+            if self._profiler is not None:
+                self._profiler.stop()
+
+    def _run_inner(self):
         consecutive_failures = 0
         while True:
             task = self._mc.get_task()
@@ -129,9 +140,13 @@ class Worker:
         record_count = 0
         last_loss = None
         for features, labels in dataset:
+            if self._profiler is not None:
+                self._profiler.before_steps(self._trainer.step)
             last_loss = self._trainer.train_step(features, labels)
             batch_count += 1
             record_count += _batch_size_of(features)
+            if self._profiler is not None:
+                self._profiler.after_steps(self._trainer.step)
             if self._trainer.step % self._report_every == 0:
                 self._report_version()
         if last_loss is not None:

@@ -1,0 +1,186 @@
+"""TensorBoard service + profiler-hook tests (reference:
+master/tensorboard_service.py; SURVEY.md §5 names jax.profiler the cheap
+observability win)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.profiler import StepProfiler, parse_profile_steps
+from elasticdl_tpu.master.tensorboard_service import TensorBoardService
+
+
+def _read_scalars(log_dir):
+    from tensorboard.backend.event_processing.event_accumulator import (
+        EventAccumulator,
+    )
+
+    acc = EventAccumulator(log_dir)
+    acc.Reload()
+    return {
+        tag: [(e.step, e.value) for e in acc.Scalars(tag)]
+        for tag in acc.Tags()["scalars"]
+    }
+
+
+class FakeTaskManager:
+    finished_record_count = 128
+
+    def counts(self):
+        return {"todo": 3, "doing": 1, "epoch": 2}
+
+
+def test_scalar_service_writes_event_files(tmp_path):
+    log_dir = str(tmp_path / "tb")
+    service = TensorBoardService(
+        log_dir,
+        task_manager=FakeTaskManager(),
+        model_version_fn=lambda: 40,
+        restarts_fn=lambda: 1,
+        sample_interval_s=3600,  # sampling driven manually below
+    )
+    service.write_dict_to_summary({"auc": 0.75, "accuracy": 0.9}, version=40)
+    service._sample_progress()
+    service.close()
+
+    assert glob.glob(os.path.join(log_dir, "events.out.tfevents.*"))
+    scalars = _read_scalars(log_dir)
+    assert scalars["eval/auc"][0] == (40, pytest.approx(0.75))
+    assert scalars["eval/accuracy"][0] == (40, pytest.approx(0.9))
+    assert scalars["train/records_finished"][0][1] == 128
+    assert scalars["train/epoch"][0][1] == 2
+    assert scalars["train/worker_restarts"][0][1] == 1
+
+
+def test_local_job_honors_tensorboard_flag(tmp_path):
+    """`--tensorboard_log_dir` end-to-end: a Local training job with
+    evaluation writes eval-metric scalars the TB event reader can load."""
+    from elasticdl_tpu.client import api
+
+    log_dir = str(tmp_path / "tb")
+    rc = api.train(
+        [
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api",
+            "--training_data", "synthetic://mnist?n=256",
+            "--validation_data", "synthetic://mnist?n=64&seed=1",
+            "--minibatch_size", "32",
+            "--num_epochs", "1",
+            "--records_per_task", "128",
+            "--distribution_strategy", "Local",
+            "--tensorboard_log_dir", log_dir,
+        ]
+    )
+    assert rc == 0
+    scalars = _read_scalars(log_dir)
+    assert any(tag.startswith("eval/") for tag in scalars), scalars.keys()
+    assert "train/records_finished" in scalars
+    # The final sample (flushed at close) saw the whole dataset trained.
+    assert scalars["train/records_finished"][-1][1] == 256
+
+
+class TestProfiler:
+    def test_parse(self):
+        assert parse_profile_steps("") is None
+        assert parse_profile_steps("5,8") == (5, 8)
+        with pytest.raises(ValueError):
+            parse_profile_steps("8,5")
+        with pytest.raises(ValueError):
+            parse_profile_steps("abc")
+
+    def test_inactive_without_steps(self, tmp_path):
+        profiler = StepProfiler(str(tmp_path), "")
+        profiler.before_steps(1)
+        profiler.after_steps(1)
+
+    def test_profile_steps_without_log_dir_rejected(self):
+        # The silently-dangling-flag failure mode: must be loud.
+        with pytest.raises(ValueError, match="tensorboard_log_dir"):
+            StepProfiler("", "1,2")
+        from elasticdl_tpu.common.args import parse_master_args
+
+        with pytest.raises(ValueError, match="tensorboard_log_dir"):
+            parse_master_args(
+                ["--model_zoo", "z", "--model_def", "m.f",
+                 "--training_data", "t", "--profile_steps", "1,2"]
+            )
+
+    def test_malformed_spec_fails_at_parse_time(self):
+        """A bad spec must fail the submission, not crash-loop workers."""
+        from elasticdl_tpu.common.args import parse_master_args
+
+        with pytest.raises(SystemExit):
+            parse_master_args(
+                ["--model_zoo", "z", "--model_def", "m.f",
+                 "--training_data", "t", "--tensorboard_log_dir", "/tb",
+                 "--profile_steps", "20,10"]
+            )
+
+    def test_traces_window(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        profiler = StepProfiler(str(tmp_path), "2,4", worker_id=0)
+        f = jax.jit(lambda x: x * 2 + 1)
+        step = 0
+        for _ in range(6):
+            profiler.before_steps(step)
+            f(jnp.ones((8,))).block_until_ready()
+            step += 1
+            profiler.after_steps(step)
+        profiler.stop()  # idempotent (already stopped after step 3)
+        trace_dir = os.path.join(str(tmp_path), "profile", "worker_0")
+        files = [
+            p
+            for p in glob.glob(os.path.join(trace_dir, "**"), recursive=True)
+            if os.path.isfile(p)
+        ]
+        assert files, "no trace files written"
+
+    def test_fused_window_rounds_outward(self, tmp_path):
+        """A trainer running 8 steps per device call with a 2-step profile
+        window traces the whole enclosing window instead of skipping."""
+        profiler = StepProfiler(str(tmp_path), "11,13", worker_id=0)
+        profiler.before_steps(0, n=8)   # steps 1..8: before window
+        assert not profiler._tracing
+        profiler.after_steps(8)
+        profiler.before_steps(8, n=8)   # steps 9..16: overlaps [11, 13)
+        assert profiler._tracing
+        profiler.after_steps(16)
+        assert not profiler._tracing and profiler._done
+
+    def test_missed_window_warns_not_silent(self, tmp_path, monkeypatch):
+        from elasticdl_tpu.common import profiler as profiler_mod
+
+        warnings = []
+        monkeypatch.setattr(
+            profiler_mod.logger,
+            "warning",
+            lambda msg, *a: warnings.append(msg % a),
+        )
+        profiler = StepProfiler(str(tmp_path), "2,3", worker_id=0)
+        profiler.before_steps(10, n=8)  # window long gone
+        assert profiler._done and not profiler._tracing
+        assert any("already passed" in w for w in warnings)
+
+
+def test_observability_flags_forward_to_workers():
+    """The flags must round-trip to worker pods or cluster jobs silently
+    lose profiling (the round-1 dangling-flag failure mode)."""
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.master.pod_manager import worker_argv_from_args
+
+    args = parse_master_args(
+        [
+            "--model_zoo", "z", "--model_def", "m.f",
+            "--training_data", "t",
+            "--tensorboard_log_dir", "/tb",
+            "--profile_steps", "10,20",
+        ]
+    )
+    argv = worker_argv_from_args(args, "localhost:1")(0)
+    joined = " ".join(argv)
+    assert "--tensorboard_log_dir /tb" in joined
+    assert "--profile_steps 10,20" in joined
