@@ -111,8 +111,12 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "<tensorboard_log_dir>/profile (TensorBoard Profile plugin)",
     )
     parser.add_argument("--task_timeout_s", type=non_neg_int, default=0)
-    parser.add_argument("--use_bf16", type=str2bool, nargs="?", const=True,
-                        default=True, help="Compute in bfloat16 on the MXU")
+    parser.add_argument(
+        "--use_bf16", type=str2bool, nargs="?", const=True, default=True,
+        help="Compute in bfloat16 on the MXU: forwarded to zoo models "
+        "whose custom_model() accepts a use_bf16 parameter (explicit "
+        "--model_params use_bf16=... wins)",
+    )
 
 
 def add_cluster_arguments(parser: argparse.ArgumentParser):

@@ -69,9 +69,25 @@ def load_model_spec(args) -> ModelSpec:
     def optional(name):
         return getattr(module, name, None) if name else None
 
+    custom_model = require("custom_model")
+    model_params = parse_dict_params(args.model_params)
+    # --use_bf16 reaches the model here: a zoo model opts into mixed
+    # precision by accepting a `use_bf16` parameter (e.g. cifar10, which
+    # selects bfloat16 conv/activation dtype on the MXU).  Explicit
+    # --model_params wins over the flag; models without the parameter are
+    # untouched.
+    import inspect
+
+    try:
+        accepts_bf16 = "use_bf16" in inspect.signature(custom_model).parameters
+    except (TypeError, ValueError):
+        accepts_bf16 = False
+    if accepts_bf16 and "use_bf16" not in model_params:
+        model_params["use_bf16"] = bool(getattr(args, "use_bf16", True))
+
     return ModelSpec(
         module=module,
-        custom_model=require("custom_model"),
+        custom_model=custom_model,
         loss=require(args.loss),
         optimizer=require(args.optimizer),
         dataset_fn=require(args.dataset_fn),
@@ -79,5 +95,5 @@ def load_model_spec(args) -> ModelSpec:
         callbacks=optional(args.callbacks),
         custom_data_reader=optional(args.custom_data_reader),
         embedding_optimizer=optional("embedding_optimizer"),
-        model_params=parse_dict_params(args.model_params),
+        model_params=model_params,
     )

@@ -86,10 +86,12 @@ class StepProfiler:
             self._done = True
             return
         if last >= start:
-            import jax
-
-            os.makedirs(self._dir, exist_ok=True)
             try:
+                import jax
+
+                # Inside the guard: an unwritable/unmounted trace dir must
+                # disable profiling, never crash training.
+                os.makedirs(self._dir, exist_ok=True)
                 jax.profiler.start_trace(self._dir)
                 self._tracing = True
                 logger.info(

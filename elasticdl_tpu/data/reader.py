@@ -69,8 +69,69 @@ class NumpyDataReader(AbstractDataReader):
             yield (self._features[i], self._labels[i])
 
 
+class _ByteLines:
+    """Line iterator over a binary file that tracks bytes consumed — the
+    probe the offset index uses to learn where record N starts."""
+
+    def __init__(self, f):
+        self._f = f
+        self.consumed = f.tell()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        line = self._f.readline()
+        if not line:
+            raise StopIteration
+        self.consumed += len(line)
+        return line.decode("utf-8")
+
+
+class _StridedOffsetIndex:
+    """Byte offset of every STRIDE-th record per file, built during the
+    counting pass `create_shards` already pays.  A task seek becomes
+    O(STRIDE + records_per_task) instead of O(file) — the round-1 CSV/text
+    readers re-scanned from byte 0 for every task, O(n^2) per epoch on one
+    big file.  Entries invalidate on (mtime, size) change."""
+
+    STRIDE = 64
+
+    def __init__(self):
+        self._entries: Dict[str, tuple] = {}
+
+    @staticmethod
+    def _stamp(path):
+        stat = os.stat(path)
+        return (stat.st_mtime_ns, stat.st_size)
+
+    def put(self, path, count, offsets):
+        self._entries[path] = (self._stamp(path), count, offsets)
+
+    def get(self, path):
+        entry = self._entries.get(path)
+        if entry is None or entry[0] != self._stamp(path):
+            return None
+        return entry[1], entry[2]
+
+    def position(self, path, start):
+        """(byte_offset, records_to_skip) to reach record `start`, or
+        None when the file isn't indexed (or changed since)."""
+        entry = self.get(path)
+        if entry is None or not entry[1]:
+            return None
+        _count, offsets = entry
+        bucket = min(start // self.STRIDE, len(offsets) - 1)
+        return offsets[bucket], start - bucket * self.STRIDE
+
+
 class CSVDataReader(AbstractDataReader):
-    """One shard per CSV file; a record is a list of string fields."""
+    """One shard per CSV file; a record is a list of string fields.
+
+    Record offsets index PARSED rows (quoted fields may contain newlines),
+    probed through _ByteLines while csv.reader pulls lines — csv consumes
+    lazily, so bytes-consumed after row i is exactly row i+1's offset.
+    """
 
     def __init__(self, data_dir: str = "", sep: str = ",", with_header: bool = True, **kwargs):
         super().__init__(**kwargs)
@@ -78,40 +139,55 @@ class CSVDataReader(AbstractDataReader):
         self._sep = sep
         self._with_header = with_header
         self._columns = None
+        self._index = _StridedOffsetIndex()
 
     def _files(self):
         if os.path.isdir(self._data_dir):
             return sorted(glob.glob(os.path.join(self._data_dir, "*.csv")))
         return sorted(glob.glob(self._data_dir))
 
-    def _count_records(self, path):
-        # Count parsed rows (not raw lines): quoted fields may contain
-        # newlines, and shard ranges must index the same record stream that
-        # read_records yields.
-        with open(path, newline="") as f:
-            count = sum(1 for _ in csv.reader(f, delimiter=self._sep))
-        return count - 1 if self._with_header else count
+    def _scan(self, path):
+        """One pass: record count + strided record offsets (+ header)."""
+        with open(path, "rb") as f:
+            lines = _ByteLines(f)
+            reader = csv.reader(lines, delimiter=self._sep)
+            if self._with_header:
+                header = next(reader, None)
+                if header is not None and self._columns is None:
+                    self._columns = header
+            count = 0
+            offsets = []
+            mark = lines.consumed
+            for _row in reader:
+                if count % _StridedOffsetIndex.STRIDE == 0:
+                    offsets.append(mark)
+                count += 1
+                mark = lines.consumed
+        self._index.put(path, count, offsets)
+        return count
 
     def create_shards(self):
-        shards = {}
-        for path in self._files():
-            shards[path] = self._count_records(path)
-            if self._with_header and self._columns is None:
-                with open(path, newline="") as f:
-                    self._columns = next(csv.reader(f, delimiter=self._sep))
-        return shards
+        return {path: self._scan(path) for path in self._files()}
 
     def read_records(self, task):
-        with open(task.shard_name, newline="") as f:
-            reader = csv.reader(f, delimiter=self._sep)
-            if self._with_header:
-                header = next(reader)
-                if self._columns is None:
-                    self._columns = header
+        position = self._index.position(task.shard_name, task.start)
+        with open(task.shard_name, "rb") as f:
+            if position is not None:
+                offset, skip = position
+                f.seek(offset)
+            else:
+                # Unindexed (file changed since create_shards, or a reader
+                # that never built shards): stream from the top, bounded by
+                # task.end — never a full-file pre-scan before row 0.
+                skip = task.start
+            reader = csv.reader(_ByteLines(f), delimiter=self._sep)
+            if position is None and self._with_header:
+                next(reader, None)
+            want = task.end - task.start
             for index, row in enumerate(reader):
-                if index < task.start:
+                if index < skip:
                     continue
-                if index >= task.end:
+                if index - skip >= want:
                     break
                 yield row
 
@@ -123,11 +199,16 @@ class CSVDataReader(AbstractDataReader):
 
 
 class TextLineDataReader(AbstractDataReader):
-    """One shard per text file; a record is a line (str, no newline)."""
+    """One shard per text file; a record is a line (str, no newline).
+
+    Strided line-offset index (built during the counting pass) gives
+    O(STRIDE + range) task seeks, same as the CSV reader.
+    """
 
     def __init__(self, data_dir: str = "", **kwargs):
         super().__init__(**kwargs)
         self._data_dir = data_dir or kwargs.get("data_path", "")
+        self._index = _StridedOffsetIndex()
 
     def _files(self):
         if os.path.isdir(self._data_dir):
@@ -140,21 +221,38 @@ class TextLineDataReader(AbstractDataReader):
             )
         return sorted(p for p in glob.glob(self._data_dir) if os.path.isfile(p))
 
+    def _scan(self, path):
+        with open(path, "rb") as f:
+            count = 0
+            offsets = []
+            mark = 0
+            for line in f:
+                if count % _StridedOffsetIndex.STRIDE == 0:
+                    offsets.append(mark)
+                count += 1
+                mark += len(line)
+        self._index.put(path, count, offsets)
+        return count
+
     def create_shards(self):
-        shards = {}
-        for path in self._files():
-            with open(path) as f:
-                shards[path] = sum(1 for _ in f)
-        return shards
+        return {path: self._scan(path) for path in self._files()}
 
     def read_records(self, task):
-        with open(task.shard_name) as f:
+        position = self._index.position(task.shard_name, task.start)
+        with open(task.shard_name, "rb") as f:
+            if position is not None:
+                offset, skip = position
+                f.seek(offset)
+            else:
+                # Unindexed: stream from the top, bounded by task.end.
+                skip = task.start
+            want = task.end - task.start
             for index, line in enumerate(f):
-                if index < task.start:
+                if index < skip:
                     continue
-                if index >= task.end:
+                if index - skip >= want:
                     break
-                yield line.rstrip("\n")
+                yield line.decode("utf-8").rstrip("\r\n")
 
 
 class RecordIODataReader(AbstractDataReader):

@@ -10,9 +10,12 @@ its shard-addressable record format.  ETRF is this framework's equivalent:
 
 The index footer makes `count_records` and `read_range` O(1) seeks instead
 of scans — that is what makes dynamic sharding cheap for the master.  The
-native C++ implementation (elasticdl_tpu/native/recordfile.cc) reads/writes
-the same format; this module is the always-available fallback and the
-reference implementation for parity tests.
+native C++ implementation (elasticdl_tpu/native/recordfile.cc) reads and
+writes the same format and is preferred automatically when the toolchain
+built it (`read_range`/`count_records` dispatch below); this module is the
+always-available fallback and the reference implementation for parity
+tests (tests/test_native_recordfile.py).  Set ELASTICDL_DISABLE_NATIVE=1
+to force the Python codec.
 """
 
 from __future__ import annotations
@@ -21,6 +24,14 @@ import os
 import struct
 import zlib
 from typing import Iterator, List
+
+
+def _native():
+    if os.environ.get("ELASTICDL_DISABLE_NATIVE"):
+        return None
+    from elasticdl_tpu import native as native_mod
+
+    return native_mod.record_file()
 
 MAGIC = b"ETRF"
 FOOTER_MAGIC = b"FTRE"
@@ -85,6 +96,18 @@ def _read_footer(f) -> tuple:
 
 
 def count_records(path: str) -> int:
+    native = _native()
+    if native is not None:
+        try:
+            return native.count_records(path)
+        except RecordFileError:
+            raise
+        except OSError as e:
+            raise RecordFileError(str(e)) from e
+    return _count_records_py(path)
+
+
+def _count_records_py(path: str) -> int:
     with open(path, "rb") as f:
         header = f.read(_HEADER.size)
         magic, _version = _HEADER.unpack(header)
@@ -95,7 +118,21 @@ def count_records(path: str) -> int:
 
 
 def read_range(path: str, start: int, end: int) -> Iterator[bytes]:
-    """Yield records [start, end) using the index footer to seek directly."""
+    """Yield records [start, end) using the index footer to seek directly.
+    Dispatches to the native C++ codec when built (one C call per range)."""
+    native = _native()
+    if native is not None:
+        try:
+            yield from native.read_range(path, start, end)
+        except RecordFileError:
+            raise
+        except OSError as e:
+            raise RecordFileError(str(e)) from e
+        return
+    yield from _read_range_py(path, start, end)
+
+
+def _read_range_py(path: str, start: int, end: int) -> Iterator[bytes]:
     with open(path, "rb") as f:
         magic, _version = _HEADER.unpack(f.read(_HEADER.size))
         if magic != MAGIC:

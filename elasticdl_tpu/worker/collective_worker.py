@@ -141,8 +141,40 @@ class CollectiveWorker:
             if self._profiler is not None:
                 self._profiler.stop()
 
+    def _verify_restore_consistency(self):
+        """Post-restore world-formation check over the control-plane
+        collective (parallel/collective.py): every rank must have picked
+        the SAME checkpoint step.  A divergent rank (filesystem race, a
+        rank whose checkpoint dir mount failed and found nothing) would
+        otherwise train from different weights and silently corrupt the
+        run — fail the process instead, so the pod manager re-forms the
+        world (reference behavior: CollectiveCommunicator membership
+        checks around re-formation)."""
+        if self._world.world_size <= 1:
+            return
+        from elasticdl_tpu.parallel.collective import (
+            CollectiveCommunicator,
+            CollectiveResult,
+        )
+
+        comm = CollectiveCommunicator(self._trainer.mesh)
+        step = float(self._last_ckpt_step)
+        status, mean_step = comm.allreduce(np.asarray(step), op="MEAN")
+        if status is not CollectiveResult.SUCCEEDED:
+            raise RuntimeError(
+                "Restore-consistency allreduce failed; re-forming world"
+            )
+        if float(mean_step) != step:
+            raise RuntimeError(
+                f"Rank {self._world.rank} restored checkpoint step "
+                f"{self._last_ckpt_step} but the world mean is "
+                f"{float(mean_step):.1f} — divergent restores; aborting "
+                "so the world re-forms from a consistent snapshot"
+            )
+
     def _run_task_loop(self):
         self.restore_from_checkpoint()
+        self._verify_restore_consistency()
         while True:
             task = self._mc.get_task() if self._world.is_leader else None
             task = elastic.broadcast_task(task, self._shard_names, self._world)

@@ -45,3 +45,30 @@ def test_args_roundtrip_to_argv():
     again = args_mod.parse_master_args(argv)
     assert again.num_workers == 4
     assert again.model_def == "m"
+
+
+def test_use_bf16_reaches_opted_in_models():
+    """Round-1 weak #8: --use_bf16 was parsed and forwarded but nothing
+    read it.  It now flows into model_params for zoo models whose
+    custom_model accepts a use_bf16 parameter; explicit model_params win;
+    models without the parameter are untouched."""
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.model_utils import load_model_spec
+
+    base = ["--model_zoo", "model_zoo", "--training_data", "t"]
+    spec = load_model_spec(parse_master_args(
+        base + ["--model_def", "cifar10.cifar10_functional_api",
+                "--use_bf16=false"]))
+    assert spec.model_params["use_bf16"] is False
+    spec = load_model_spec(parse_master_args(
+        base + ["--model_def", "cifar10.cifar10_functional_api"]))
+    assert spec.model_params["use_bf16"] is True  # flag default
+    # Explicit model_params override the flag.
+    spec = load_model_spec(parse_master_args(
+        base + ["--model_def", "cifar10.cifar10_functional_api",
+                "--use_bf16=false", "--model_params", "use_bf16=true"]))
+    assert spec.model_params["use_bf16"] is True
+    # Models that don't opt in see nothing.
+    spec = load_model_spec(parse_master_args(
+        base + ["--model_def", "mnist.mnist_functional_api"]))
+    assert "use_bf16" not in spec.model_params

@@ -1,0 +1,105 @@
+"""Native <-> Python ETRF codec parity tests.
+
+The C++ codec (native/recordfile.cc) must be byte-identical with the
+pure-Python reference implementation (data/recordfile.py) in both
+directions: files written by either are read by both, CRC corruption is
+detected by both, and range semantics (clamping, empty) match.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu import native
+from elasticdl_tpu.data import recordfile
+
+pytestmark = pytest.mark.skipif(
+    native.record_file() is None,
+    reason="no C++ toolchain; native codec unavailable",
+)
+
+RECORDS = [
+    b"hello",
+    b"",
+    b"x" * 5000,
+    np.arange(64, dtype=np.int32).tobytes(),
+    b"\x00\xff" * 33,
+]
+
+
+def test_python_written_native_read(tmp_path):
+    path = str(tmp_path / "py.etrf")
+    recordfile.write_records(path, RECORDS)  # pure-Python writer
+    codec = native.record_file()
+    assert codec.count_records(path) == len(RECORDS)
+    assert list(codec.read_range(path, 0, len(RECORDS))) == RECORDS
+    # Range semantics: clamping + interior slice + empty.
+    assert list(codec.read_range(path, 2, 4)) == RECORDS[2:4]
+    assert list(codec.read_range(path, -3, 99)) == RECORDS
+    assert list(codec.read_range(path, 4, 4)) == []
+
+
+def test_native_written_python_read(tmp_path):
+    path = str(tmp_path / "native.etrf")
+    codec = native.record_file()
+    assert codec.write_records(path, RECORDS) == len(RECORDS)
+    # Force the pure-Python read path for the parity check.
+    assert recordfile._count_records_py(path) == len(RECORDS)
+    assert list(recordfile._read_range_py(path, 0, len(RECORDS))) == RECORDS
+
+
+def test_native_written_byte_identical_to_python(tmp_path):
+    py_path = str(tmp_path / "py.etrf")
+    native_path = str(tmp_path / "native.etrf")
+    recordfile.write_records(py_path, RECORDS)
+    native.record_file().write_records(native_path, RECORDS)
+    with open(py_path, "rb") as a, open(native_path, "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_crc_corruption_detected_by_both(tmp_path):
+    path = str(tmp_path / "corrupt.etrf")
+    recordfile.write_records(path, [b"payload-one", b"payload-two"])
+    # Flip one payload byte of record 0 (after 8B header + 8B record head).
+    with open(path, "r+b") as f:
+        f.seek(8 + 8 + 2)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(IOError, match="CRC"):
+        list(native.record_file().read_range(path, 0, 2))
+    with pytest.raises(recordfile.RecordFileError, match="CRC"):
+        list(recordfile._read_range_py(path, 0, 2))
+
+
+def test_bad_files_rejected(tmp_path):
+    codec = native.record_file()
+    garbage = tmp_path / "garbage.bin"
+    garbage.write_bytes(b"not a record file at all")
+    with pytest.raises(IOError):
+        codec.count_records(str(garbage))
+    with pytest.raises(IOError):
+        codec.count_records(str(tmp_path / "missing.etrf"))
+
+
+def test_reader_dispatches_to_native(tmp_path, monkeypatch):
+    """data/recordfile.py's public functions use the native codec when
+    built — the docstring's promise, previously unimplemented."""
+    path = str(tmp_path / "dispatch.etrf")
+    recordfile.write_records(path, RECORDS)
+    calls = []
+    codec = native.record_file()
+    real = codec.read_range
+
+    def spy(path, start, end):
+        calls.append((start, end))
+        return real(path, start, end)
+
+    monkeypatch.setattr(codec, "read_range", spy)
+    assert list(recordfile.read_range(path, 1, 3)) == RECORDS[1:3]
+    assert calls == [(1, 3)]
+    # Escape hatch: the env var forces the Python codec.
+    monkeypatch.setenv("ELASTICDL_DISABLE_NATIVE", "1")
+    assert list(recordfile.read_range(path, 1, 3)) == RECORDS[1:3]
+    assert calls == [(1, 3)]

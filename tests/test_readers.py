@@ -3,6 +3,7 @@
 import numpy as np
 
 from elasticdl_tpu.data import recordfile
+from elasticdl_tpu.data import reader as reader_mod
 from elasticdl_tpu.data.reader import (
     CSVDataReader,
     NumpyDataReader,
@@ -93,3 +94,78 @@ class TestCSVQuotedNewlines:
         assert shards == {str(path): 2}
         rows = list(reader.read_records(make_task(str(path), 0, 2)))
         assert rows == [["a", "multi\nline"], ["b", "c"]]
+
+
+class TestStridedOffsetIndex:
+    """Round-1 weak #6: CSV/text readers re-scanned from byte 0 for every
+    task (O(n^2) per epoch).  The strided offset index built during the
+    counting pass makes task reads seek near the target record."""
+
+    def _task(self, shard, start, end):
+        from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+        return pb.Task(task_id=1, shard_name=shard, start=start, end=end)
+
+    def test_csv_mid_file_task_seeks(self, tmp_path):
+        path = tmp_path / "big.csv"
+        with open(path, "w") as f:
+            f.write("id,value\n")
+            for i in range(1000):
+                f.write(f"{i},v{i}\n")
+        reader = CSVDataReader(data_dir=str(path))
+        shards = reader.create_shards()
+        assert shards[str(path)] == 1000
+        rows = list(reader.read_records(self._task(str(path), 900, 910)))
+        assert rows == [[str(i), f"v{i}"] for i in range(900, 910)]
+        # The read started from a strided offset, not byte 0: it consumed
+        # at most STRIDE + range records, far fewer than 900.
+        consumed = []
+
+        class Probe(reader_mod._ByteLines):
+            def __next__(probe_self):
+                line = super(Probe, probe_self).__next__()
+                consumed.append(line)
+                return line
+
+        original = reader_mod._ByteLines
+        reader_mod._ByteLines = Probe
+        try:
+            list(reader.read_records(self._task(str(path), 900, 910)))
+        finally:
+            reader_mod._ByteLines = original
+        assert len(consumed) <= reader_mod._StridedOffsetIndex.STRIDE + 10
+
+    def test_csv_quoted_newlines_survive_sharded_reads(self, tmp_path):
+        path = tmp_path / "quoted.csv"
+        with open(path, "w", newline="") as f:
+            for i in range(200):
+                f.write(f'{i},"line one\nline two {i}"\r\n')
+        reader = CSVDataReader(data_dir=str(path), with_header=False)
+        shards = reader.create_shards()
+        assert shards[str(path)] == 200  # parsed rows, not raw lines
+        rows = list(reader.read_records(self._task(str(path), 130, 133)))
+        assert rows == [
+            [str(i), f"line one\nline two {i}"] for i in range(130, 133)
+        ]
+
+    def test_textline_mid_file_task(self, tmp_path):
+        path = tmp_path / "lines.txt"
+        path.write_text("".join(f"line-{i}\n" for i in range(500)))
+        reader = TextLineDataReader(data_dir=str(path))
+        assert reader.create_shards()[str(path)] == 500
+        got = list(reader.read_records(self._task(str(path), 450, 455)))
+        assert got == [f"line-{i}" for i in range(450, 455)]
+
+    def test_index_invalidates_on_file_change(self, tmp_path):
+        path = tmp_path / "data.txt"
+        path.write_text("a\nb\nc\n")
+        reader = TextLineDataReader(data_dir=str(path))
+        reader.create_shards()
+        # File replaced with different content: the index must not serve
+        # stale offsets.
+        import time as _time
+
+        _time.sleep(0.01)
+        path.write_text("".join(f"x{i}\n" for i in range(100)))
+        got = list(reader.read_records(self._task(str(path), 64, 66)))
+        assert got == ["x64", "x65"]
