@@ -125,6 +125,20 @@ class _StridedOffsetIndex:
         return offsets[bucket], start - bucket * self.STRIDE
 
 
+def _resolve_position(index, scan, task):
+    """Index lookup with self-healing: a miss (index never built — e.g. a
+    Local-mode worker whose shard list came from the master — or
+    invalidated by an mtime change) triggers ONE rebuilding scan when the
+    task starts deep enough in the file that streaming from the top would
+    cost more than the scan amortizes over subsequent tasks.  Shallow
+    tasks just stream (no full-file pre-scan before row 0)."""
+    position = index.position(task.shard_name, task.start)
+    if position is None and task.start >= 4 * _StridedOffsetIndex.STRIDE:
+        scan(task.shard_name)
+        position = index.position(task.shard_name, task.start)
+    return position
+
+
 class CSVDataReader(AbstractDataReader):
     """One shard per CSV file; a record is a list of string fields.
 
@@ -170,15 +184,14 @@ class CSVDataReader(AbstractDataReader):
         return {path: self._scan(path) for path in self._files()}
 
     def read_records(self, task):
-        position = self._index.position(task.shard_name, task.start)
+        position = self._resolve_position(task)
         with open(task.shard_name, "rb") as f:
             if position is not None:
                 offset, skip = position
                 f.seek(offset)
             else:
-                # Unindexed (file changed since create_shards, or a reader
-                # that never built shards): stream from the top, bounded by
-                # task.end — never a full-file pre-scan before row 0.
+                # Unindexed near the top of the file: stream, bounded by
+                # task.end — no full-file pre-scan before row 0.
                 skip = task.start
             reader = csv.reader(_ByteLines(f), delimiter=self._sep)
             if position is None and self._with_header:
@@ -190,6 +203,9 @@ class CSVDataReader(AbstractDataReader):
                 if index - skip >= want:
                     break
                 yield row
+
+    def _resolve_position(self, task):
+        return _resolve_position(self._index, self._scan, task)
 
     @property
     def metadata(self):
@@ -238,13 +254,13 @@ class TextLineDataReader(AbstractDataReader):
         return {path: self._scan(path) for path in self._files()}
 
     def read_records(self, task):
-        position = self._index.position(task.shard_name, task.start)
+        position = _resolve_position(self._index, self._scan, task)
         with open(task.shard_name, "rb") as f:
             if position is not None:
                 offset, skip = position
                 f.seek(offset)
             else:
-                # Unindexed: stream from the top, bounded by task.end.
+                # Unindexed near the top: stream, bounded by task.end.
                 skip = task.start
             want = task.end - task.start
             for index, line in enumerate(f):

@@ -92,7 +92,7 @@ def _bind(lib):
     lib.edl_rf_count.restype = ll
     lib.edl_rf_range_size.argtypes = [voidp, ll, ll]
     lib.edl_rf_range_size.restype = ll
-    lib.edl_rf_read_range.argtypes = [voidp, ll, ll, u8p, u32p]
+    lib.edl_rf_read_range.argtypes = [voidp, ll, ll, u8p, ll, u32p]
     lib.edl_rf_read_range.restype = ll
     lib.edl_rf_close.argtypes = [voidp]
     lib.edl_rf_writer_open.argtypes = [ctypes.c_char_p]
@@ -113,7 +113,23 @@ def load():
     if path is None:
         _load_failed = True
         return None
-    _lib = _bind(ctypes.CDLL(path))
+    try:
+        _lib = _bind(ctypes.CDLL(path))
+    except (OSError, AttributeError):
+        # Corrupt/arch-mismatched .so, or a stale one predating newer
+        # symbols but with a fresher mtime (tar/rsync preserve source
+        # timestamps): rebuild once from source before giving up.
+        logger.warning("Native library at %s unusable; rebuilding", path)
+        path = build_native(force=True)
+        if path is None:
+            _load_failed = True
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(path))
+        except Exception:
+            logger.exception("Rebuilt native library still unusable")
+            _load_failed = True
+            return None
     return _lib
 
 
@@ -254,6 +270,7 @@ class NativeRecordFile:
                     pos,
                     pos + n,
                     buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    total,
                     lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
                 )
                 if read < 0:
@@ -295,11 +312,18 @@ _record_file_failed = False
 
 
 def record_file() -> Optional[NativeRecordFile]:
-    """Singleton NativeRecordFile, or None when native is unavailable."""
+    """Singleton NativeRecordFile, or None when native is unavailable.
+    Catches EVERYTHING construction can throw (no toolchain, corrupt or
+    arch-mismatched .so from CDLL, stale .so missing the edl_rf_* symbols
+    in _bind) — the Python codec is the always-available fallback and a
+    broken native build must never take the data plane down."""
     global _record_file, _record_file_failed
     if _record_file is None and not _record_file_failed:
         try:
             _record_file = NativeRecordFile()
-        except RuntimeError:
+        except Exception:
+            logger.exception(
+                "Native record file unavailable; using the Python codec"
+            )
             _record_file_failed = True
     return _record_file

@@ -181,15 +181,23 @@ long long edl_rf_range_size(void* handle, long long start, long long end) {
   uint64_t boundary = (end < static_cast<long long>(r->count))
                           ? r->index[end]
                           : r->index_offset;
-  return static_cast<long long>(boundary - r->index[start]) -
-         static_cast<long long>(kRecordHead) * (end - start);
+  long long total = static_cast<long long>(boundary - r->index[start]) -
+                    static_cast<long long>(kRecordHead) * (end - start);
+  if (boundary < r->index[start] || total < 0) {
+    set_error("corrupt index (non-monotonic offsets)");
+    return -1;
+  }
+  return total;
 }
 
-// Read records [start, end) into buf (payloads back-to-back), lengths[i]
-// = payload length of record start+i.  CRC-checked.  Returns records
-// read, or -1 on error.
+// Read records [start, end) into buf (payloads back-to-back, at most
+// buf_size bytes), lengths[i] = payload length of record start+i.
+// CRC-checked; a record whose length field would overrun the caller's
+// buffer (corrupt length byte) errors out instead of writing past it.
+// Returns records read, or -1 on error.
 long long edl_rf_read_range(void* handle, long long start, long long end,
-                            uint8_t* buf, uint32_t* lengths) {
+                            uint8_t* buf, long long buf_size,
+                            uint32_t* lengths) {
   Reader* r = static_cast<Reader*>(handle);
   if (start < 0) start = 0;
   if (end > static_cast<long long>(r->count)) end = r->count;
@@ -200,6 +208,7 @@ long long edl_rf_read_range(void* handle, long long start, long long end,
     return -1;
   }
   uint8_t* out = buf;
+  long long remaining = buf_size;
   for (long long i = start; i < end; ++i) {
     uint8_t head[kRecordHead];
     if (fread(head, 1, kRecordHead, r->file) != kRecordHead) {
@@ -208,6 +217,10 @@ long long edl_rf_read_range(void* handle, long long start, long long end,
     }
     uint32_t length = read_u32(head);
     uint32_t crc = read_u32(head + 4);
+    if (static_cast<long long>(length) > remaining) {
+      set_error("record length exceeds buffer (corrupt length field)");
+      return -1;
+    }
     if (fread(out, 1, length, r->file) != length) {
       set_error("truncated record");
       return -1;
@@ -218,6 +231,7 @@ long long edl_rf_read_range(void* handle, long long start, long long end,
     }
     lengths[i - start] = length;
     out += length;
+    remaining -= length;
   }
   return end - start;
 }

@@ -158,18 +158,21 @@ class CollectiveWorker:
         )
 
         comm = CollectiveCommunicator(self._trainer.mesh)
-        step = float(self._last_ckpt_step)
-        status, mean_step = comm.allreduce(np.asarray(step), op="MEAN")
+        # Exact-integer comparison against the leader's step (a float MEAN
+        # would round in float32 past 2^24 steps and false-abort healthy
+        # long-running worlds).
+        step = int(self._last_ckpt_step)
+        status, leader_step = comm.broadcast(np.int64(step), root=0)
         if status is not CollectiveResult.SUCCEEDED:
             raise RuntimeError(
-                "Restore-consistency allreduce failed; re-forming world"
+                "Restore-consistency broadcast failed; re-forming world"
             )
-        if float(mean_step) != step:
+        if int(leader_step) != step:
             raise RuntimeError(
                 f"Rank {self._world.rank} restored checkpoint step "
-                f"{self._last_ckpt_step} but the world mean is "
-                f"{float(mean_step):.1f} — divergent restores; aborting "
-                "so the world re-forms from a consistent snapshot"
+                f"{step} but rank 0 restored {int(leader_step)} — "
+                "divergent restores; aborting so the world re-forms "
+                "from a consistent snapshot"
             )
 
     def _run_task_loop(self):

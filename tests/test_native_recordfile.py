@@ -73,6 +73,19 @@ def test_crc_corruption_detected_by_both(tmp_path):
         list(recordfile._read_range_py(path, 0, 2))
 
 
+def test_corrupt_length_field_is_an_error_not_an_overflow(tmp_path):
+    """A flipped bit in a record's LENGTH field must surface as a clean
+    error: the native reader bounds every record against the caller's
+    buffer before writing (a naive implementation heap-overflows here)."""
+    path = str(tmp_path / "len.etrf")
+    recordfile.write_records(path, [b"abcdef", b"ghijkl"])
+    with open(path, "r+b") as f:
+        f.seek(8)  # record 0's u32 length field
+        f.write((6 | 0x40000000).to_bytes(4, "little"))
+    with pytest.raises(IOError, match="length|truncated"):
+        list(native.record_file().read_range(path, 0, 2))
+
+
 def test_bad_files_rejected(tmp_path):
     codec = native.record_file()
     garbage = tmp_path / "garbage.bin"

@@ -182,12 +182,13 @@ class TestRestoreConsistency:
         from elasticdl_tpu.parallel import collective as coll
 
         worker = self._worker()
-        worker._last_ckpt_step = 40
+        # Exact-int comparison: must hold even past float32's 2^24.
+        worker._last_ckpt_step = 2**24 + 1
         monkeypatch.setattr(
             coll.CollectiveCommunicator,
-            "allreduce",
-            lambda self, data, op="MEAN": (
-                coll.CollectiveResult.SUCCEEDED, np.asarray(40.0)
+            "broadcast",
+            lambda self, data, root=0: (
+                coll.CollectiveResult.SUCCEEDED, np.int64(2**24 + 1)
             ),
         )
         worker._verify_restore_consistency()  # no raise
@@ -199,9 +200,9 @@ class TestRestoreConsistency:
         worker._last_ckpt_step = 40
         monkeypatch.setattr(
             coll.CollectiveCommunicator,
-            "allreduce",
-            lambda self, data, op="MEAN": (
-                coll.CollectiveResult.SUCCEEDED, np.asarray(20.0)
+            "broadcast",
+            lambda self, data, root=0: (
+                coll.CollectiveResult.SUCCEEDED, np.int64(20)
             ),
         )
         with pytest.raises(RuntimeError, match="divergent restores"):
@@ -213,8 +214,8 @@ class TestRestoreConsistency:
         worker = self._worker()
         monkeypatch.setattr(
             coll.CollectiveCommunicator,
-            "allreduce",
-            lambda self, data, op="MEAN": (coll.CollectiveResult.FAILED, None),
+            "broadcast",
+            lambda self, data, root=0: (coll.CollectiveResult.FAILED, None),
         )
         with pytest.raises(RuntimeError, match="re-forming"):
             worker._verify_restore_consistency()
