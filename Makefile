@@ -1,0 +1,35 @@
+# Build system (parity: the reference's Makefile — protoc gen, native
+# build, packaging, tests).  Everything also happens automatically at
+# first use (pb2 is checked in; the native .so builds lazily); these
+# targets are the explicit developer entry points.
+
+.PHONY: all proto native test e2e bench wheel clean
+
+all: proto native test
+
+proto:
+	bash scripts/gen_protobuf.sh
+
+native:
+	python -c "from elasticdl_tpu import native; \
+	           path = native.build_native(force=True); \
+	           assert path, 'native build failed'; print(path)"
+
+test:
+	python -m pytest tests/ -q
+
+# The real multi-process end-to-end slices only (elasticity, PS, k8s).
+e2e:
+	python -m pytest tests/test_allreduce_e2e.py tests/test_ps_e2e.py \
+	       tests/test_cluster_eval_e2e.py tests/test_k8s.py -q
+
+bench:
+	python bench.py
+
+wheel:
+	python -m pip wheel --no-deps --wheel-dir dist .
+
+clean:
+	rm -rf dist build .elasticdl_build
+	rm -f elasticdl_tpu/native/libedl_kernels.so
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -303,11 +303,18 @@ class RecordIODataReader(AbstractDataReader):
         yield from recordfile.read_range(task.shard_name, task.start, task.end)
 
 
+def _odps_reader(**kwargs):
+    from elasticdl_tpu.data.odps_reader import ODPSDataReader
+
+    return ODPSDataReader(**kwargs)
+
+
 _READERS = {
     "numpy": NumpyDataReader,
     "csv": CSVDataReader,
     "textline": TextLineDataReader,
     "recordio": RecordIODataReader,
+    "odps": _odps_reader,
 }
 
 

@@ -36,11 +36,19 @@ _load_failed = False
 def build_native(force: bool = False) -> Optional[str]:
     """Compile the native sources -> libedl_kernels.so. Returns the path,
     or None when no toolchain / compile failure."""
-    if os.path.exists(_SO_PATH) and not force:
-        if os.path.getmtime(_SO_PATH) >= max(
+    if os.path.exists(_SO_PATH):
+        if not force and os.path.getmtime(_SO_PATH) >= max(
             os.path.getmtime(src) for src in _SOURCES
         ):
             return _SO_PATH
+        # Unlink before relinking: if the stale .so is already dlopen'd,
+        # a fresh inode is the only way a retry CDLL sees the new build
+        # (dlopen caches by pathname/inode), and overwriting a mapped
+        # file risks SIGBUS in the running process.
+        try:
+            os.unlink(_SO_PATH)
+        except OSError:
+            pass
     for compiler in ("g++", "c++", "clang++"):
         try:
             subprocess.run(
@@ -62,7 +70,21 @@ def build_native(force: bool = False) -> Optional[str]:
     return None
 
 
+# Must match edl_abi_version() in recordfile.cc; bump both on any C-ABI
+# change so a stale .so can never be called with shifted arguments.
+_ABI_VERSION = 2
+
+
 def _bind(lib):
+    # ABI gate FIRST: a pre-versioning .so lacks the symbol entirely
+    # (AttributeError), an outdated one returns the wrong number — both
+    # route to the rebuild path in load().
+    lib.edl_abi_version.restype = ctypes.c_longlong
+    found = int(lib.edl_abi_version())
+    if found != _ABI_VERSION:
+        raise AttributeError(
+            f"native ABI {found} != expected {_ABI_VERSION} (stale .so)"
+        )
     f32p = ctypes.POINTER(ctypes.c_float)
     i64p = ctypes.POINTER(ctypes.c_int64)
     i64 = ctypes.c_int64

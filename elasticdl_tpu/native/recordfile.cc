@@ -118,6 +118,12 @@ bool load_index(Reader* r) {
 
 extern "C" {
 
+// Bumped on ANY C-ABI change (argument lists included): the Python side
+// refuses to bind a library whose version doesn't match, which converts
+// "stale .so with a fresher mtime called with shifted arguments" from
+// heap corruption into a clean rebuild.
+long long edl_abi_version() { return 2; }
+
 const char* edl_rf_last_error() { return g_last_error.c_str(); }
 
 // ---------------------------------------------------------------------

@@ -1,0 +1,92 @@
+"""`elasticdl evaluate` / `elasticdl predict` under cluster strategies —
+real multi-process worlds (round-1 weak #10: these modes were only ever
+tested in Local mode).
+
+The evaluate job doubles as the cluster TensorBoard e2e: metrics
+aggregated by the master's EvaluationService land in event files the TB
+reader can load.
+"""
+
+import glob
+import os
+
+import pytest
+
+from elasticdl_tpu.client import api
+
+WORKER_ENV = {
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "ELASTICDL_FORCE_PLATFORM": "cpu",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+@pytest.fixture
+def worker_env(monkeypatch):
+    monkeypatch.setenv("ELASTICDL_FORCE_PLATFORM", "cpu")
+    monkeypatch.setenv(
+        "ELASTICDL_WORKER_ENV",
+        ";".join(f"{k}={v}" for k, v in WORKER_ENV.items()),
+    )
+
+
+def _read_scalars(log_dir):
+    from tensorboard.backend.event_processing.event_accumulator import (
+        EventAccumulator,
+    )
+
+    acc = EventAccumulator(log_dir)
+    acc.Reload()
+    return {
+        tag: [(e.step, e.value) for e in acc.Scalars(tag)]
+        for tag in acc.Tags()["scalars"]
+    }
+
+
+def test_evaluate_under_allreduce_two_workers(tmp_path, worker_env):
+    """Evaluation-only job through a 2-process world: the version-0 round
+    runs through trigger_evaluation, workers gather outputs collectively,
+    and the master aggregates metrics (asserted via the TB event file)."""
+    log_dir = str(tmp_path / "tb")
+    rc = api.evaluate(
+        [
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api",
+            "--validation_data", "synthetic://mnist?n=128&seed=1",
+            "--records_per_task", "64",
+            "--minibatch_size", "16",
+            "--num_workers", "2",
+            "--distribution_strategy", "AllreduceStrategy",
+            f"--checkpoint_dir={tmp_path / 'ckpt'}",
+            "--job_name", "evaljob",
+            "--tensorboard_log_dir", log_dir,
+        ]
+    )
+    assert rc == 0
+    scalars = _read_scalars(log_dir)
+    eval_tags = [t for t in scalars if t.startswith("eval/")]
+    assert eval_tags, f"no eval metrics written: {scalars.keys()}"
+    # All 128 validation examples were aggregated in the version-0 round.
+    assert any(
+        scalars[t][0][0] == 0 for t in eval_tags
+    ), "metrics not recorded at model version 0"
+
+
+def test_predict_under_ps_two_workers(tmp_path, worker_env):
+    """Prediction-only job through a 2-process PS-mode world (sharded
+    tables): every prediction record is processed and the job completes."""
+    rc = api.predict(
+        [
+            "--model_zoo", "model_zoo",
+            "--model_def", "deepfm.deepfm_functional_api",
+            "--prediction_data", "synthetic://criteo?n=128&vocab=100",
+            "--model_params", "vocab_size=100",
+            "--records_per_task", "64",
+            "--minibatch_size", "16",
+            "--num_workers", "2",
+            "--distribution_strategy", "ParameterServerStrategy",
+            f"--checkpoint_dir={tmp_path / 'ckpt'}",
+            "--job_name", "predictjob",
+        ]
+    )
+    assert rc == 0

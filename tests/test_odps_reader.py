@@ -1,0 +1,86 @@
+"""ODPS table reader tests over a fake TableClient (the real `odps` SDK
+is cloud-specific; the reader's sharding/range semantics — what the task
+queue depends on — are transport-independent and pinned here)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.odps_reader import ODPSDataReader, TableClient
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+class FakeTableClient(TableClient):
+    def __init__(self, rows, columns=("a", "b")):
+        self.rows = rows
+        self.columns = list(columns)
+        self.read_calls = []
+
+    def row_count(self, table, partition):
+        assert table == "mytable"
+        return len(self.rows)
+
+    def read_rows(self, table, partition, start, count, columns):
+        self.read_calls.append((start, count))
+        for row in self.rows[start : start + count]:
+            yield row
+
+    def column_names(self, table):
+        return self.columns
+
+
+def _task(shard, start, end):
+    return pb.Task(task_id=1, shard_name=shard, start=start, end=end)
+
+
+@pytest.fixture
+def fake_client():
+    return FakeTableClient([[i, f"v{i}"] for i in range(100)])
+
+
+def test_shards_and_range_reads(fake_client):
+    reader = ODPSDataReader(table="mytable", client=fake_client)
+    assert reader.create_shards() == {"mytable": 100}
+    rows = list(reader.read_records(_task("mytable", 40, 45)))
+    assert rows == [[i, f"v{i}"] for i in range(40, 45)]
+    # Range pushdown: only the requested window crossed the transport.
+    assert fake_client.read_calls == [(40, 5)]
+    assert reader.metadata.column_names == ["a", "b"]
+
+
+def test_partition_names_shard(fake_client):
+    reader = ODPSDataReader(
+        table="mytable", partition="dt=20260730", client=fake_client
+    )
+    assert reader.create_shards() == {"mytable/dt=20260730": 100}
+
+
+def test_columns_filter_and_empty_range(fake_client):
+    reader = ODPSDataReader(
+        table="mytable", columns="b;a", client=fake_client
+    )
+    assert reader.metadata.column_names == ["b", "a"]
+    assert list(reader.read_records(_task("mytable", 7, 7))) == []
+
+
+def test_factory_resolves_odps_scheme(fake_client, monkeypatch):
+    import elasticdl_tpu.data.odps_reader as mod
+
+    captured = {}
+    original = mod.ODPSDataReader
+
+    def spy(**kwargs):
+        captured.update(kwargs)
+        kwargs["client"] = fake_client
+        return original(**kwargs)
+
+    monkeypatch.setattr(mod, "ODPSDataReader", spy)
+    reader = create_data_reader("odps://mytable")
+    assert reader.create_shards() == {"mytable": 100}
+
+
+def test_missing_credentials_fail_clearly(monkeypatch):
+    for var in ("ODPS_ACCESS_ID", "ODPS_ACCESS_KEY", "ODPS_PROJECT_NAME"):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(ValueError, match="ODPS credentials"):
+        ODPSDataReader(table="mytable")
