@@ -117,6 +117,15 @@ def prepare_build_context(
     # zoo/framework since the last build and bake them into the image.
     framework_dst = os.path.join(context_dir, "elasticdl_tpu")
     zoo_dst = os.path.join(context_dir, zoo_name)
+    for src, dst in ((framework_src, framework_dst), (zoo_path, zoo_dst)):
+        # NEVER delete the source itself: `--context .` from the repo root
+        # would make dst == src and wipe the user's real code.
+        if os.path.realpath(dst) == os.path.realpath(src):
+            raise ValueError(
+                f"Build context {context_dir!r} would overwrite the source "
+                f"directory {src!r}; choose a --context outside the "
+                "source trees"
+            )
     shutil.rmtree(framework_dst, ignore_errors=True)
     shutil.rmtree(zoo_dst, ignore_errors=True)
     shutil.copytree(
@@ -210,6 +219,10 @@ def main(argv):
                     f.write(content)
         print(f"Initialized model zoo at {args.path}")
         return 0
-    if args.action == "build":
-        return build(args)
-    return push(args)
+    try:
+        if args.action == "build":
+            return build(args)
+        return push(args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1

@@ -135,17 +135,24 @@ class ODPSDataReader(AbstractDataReader):
 
     # -- AbstractDataReader ----------------------------------------------
 
+    def _shard_name(self) -> str:
+        return (
+            f"{self._table}/{self._partition}"
+            if self._partition
+            else self._table
+        )
+
+    def shard_names(self):
+        """Config-derived: no table-count RPC — N workers calling this at
+        boot must not fan N redundant tunnel-reader opens at the cloud."""
+        return [self._shard_name()]
+
     def create_shards(self):
         if self._count is None:
             self._count = int(
                 self._client.row_count(self._table, self._partition)
             )
-        shard = (
-            f"{self._table}/{self._partition}"
-            if self._partition
-            else self._table
-        )
-        return {shard: self._count}
+        return {self._shard_name(): self._count}
 
     def read_records(self, task) -> Iterator:
         start = max(0, task.start)

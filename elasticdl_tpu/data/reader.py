@@ -42,6 +42,14 @@ class AbstractDataReader(ABC):
     def read_records(self, task) -> Iterator:
         """Yield raw records for task.shard_name[task.start:task.end]."""
 
+    def shard_names(self):
+        """Deterministic shard-name listing WITHOUT counting records.
+        Workers use this to index the task-broadcast encoding; only the
+        master's task queue needs the counts (create_shards) — readers
+        whose counting is expensive (ODPS table tunnel, big-file scans)
+        override this to skip it."""
+        return list(self.create_shards().keys())
+
     @property
     def metadata(self) -> Metadata:
         return Metadata()

@@ -78,11 +78,13 @@ class CollectiveWorker:
         }
         # Deterministic shard listing — identical on every rank (same
         # readers over the same data); indexes the task-broadcast encoding.
+        # shard_names(), not create_shards(): workers never need the record
+        # counts, and counting can be a network round-trip (ODPS).
         names: List[str] = []
         for reader in (data_reader, validation_data_reader, prediction_data_reader):
             if reader is None:
                 continue
-            for name in reader.create_shards().keys():
+            for name in reader.shard_names():
                 if name not in names:
                     names.append(name)
         self._shard_names = names

@@ -159,6 +159,9 @@ class TestRestoreConsistency:
             def create_shards(self):
                 return {"s": 4}
 
+            def shard_names(self):
+                return ["s"]
+
         class FakeTrainer:
             mesh = build_mesh(MeshConfig())
 

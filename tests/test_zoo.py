@@ -52,11 +52,23 @@ def test_build_renders_self_contained_context(tmp_path):
     )
 
 
-def test_build_missing_zoo_errors(tmp_path):
-    import pytest
+def test_build_missing_zoo_errors(tmp_path, capsys):
+    rc = zoo.main(
+        ["build", str(tmp_path / "nope"), "--context",
+         str(tmp_path / "ctx"), "--dockerfile-only"]
+    )
+    assert rc == 1
+    assert "not found" in capsys.readouterr().err
 
-    with pytest.raises(ValueError, match="not found"):
-        zoo.main(
-            ["build", str(tmp_path / "nope"), "--context",
-             str(tmp_path / "ctx"), "--dockerfile-only"]
-        )
+
+def test_build_refuses_context_overwriting_source(tmp_path, capsys):
+    """`--context` pointing at the source's parent must never rmtree the
+    user's real code."""
+    zoo_dir = str(tmp_path / "myzoo")
+    zoo.main(["init", zoo_dir])
+    rc = zoo.main(
+        ["build", zoo_dir, "--context", str(tmp_path), "--dockerfile-only"]
+    )
+    assert rc == 1
+    assert "overwrite the source" in capsys.readouterr().err
+    assert os.path.exists(os.path.join(zoo_dir, "my_model.py"))  # intact
