@@ -118,13 +118,19 @@ def prepare_build_context(
     framework_dst = os.path.join(context_dir, "elasticdl_tpu")
     zoo_dst = os.path.join(context_dir, zoo_name)
     for src, dst in ((framework_src, framework_dst), (zoo_path, zoo_dst)):
-        # NEVER delete the source itself: `--context .` from the repo root
-        # would make dst == src and wipe the user's real code.
-        if os.path.realpath(dst) == os.path.realpath(src):
+        # NEVER delete or recurse into the source: `--context .` from the
+        # repo root makes dst == src (rmtree would wipe the user's real
+        # code), and a context NESTED inside a source tree makes copytree
+        # copy the destination into itself without terminating.
+        real_src, real_dst = os.path.realpath(src), os.path.realpath(dst)
+        if (
+            real_dst == real_src
+            or os.path.commonpath([real_dst, real_src]) == real_src
+        ):
             raise ValueError(
-                f"Build context {context_dir!r} would overwrite the source "
-                f"directory {src!r}; choose a --context outside the "
-                "source trees"
+                f"Build context {context_dir!r} would overwrite or nest "
+                f"inside the source directory {src!r}; choose a --context "
+                "outside the source trees"
             )
     shutil.rmtree(framework_dst, ignore_errors=True)
     shutil.rmtree(zoo_dst, ignore_errors=True)

@@ -105,6 +105,13 @@ def add_train_arguments(parser: argparse.ArgumentParser):
     parser.add_argument("--output", default="", help="Trained model output path")
     parser.add_argument("--tensorboard_log_dir", default="")
     parser.add_argument(
+        "--train_window_steps", type=non_neg_int, default=0,
+        help="Training batches fused per device dispatch in cluster "
+        "strategies (0 = framework default of 8). Larger windows amortize "
+        "per-dispatch host latency (see BASELINE.md) at the cost of "
+        "staged-batch memory and checkpoint granularity.",
+    )
+    parser.add_argument(
         "--profile_steps", default="", type=_profile_steps_spec,
         help="'START,END': each worker captures a jax.profiler trace of "
         "its training steps in [START, END) under "

@@ -168,6 +168,11 @@ class CSVDataReader(AbstractDataReader):
             return sorted(glob.glob(os.path.join(self._data_dir, "*.csv")))
         return sorted(glob.glob(self._data_dir))
 
+    def shard_names(self):
+        # Shard name == file path: workers list shards without the
+        # counting scan create_shards pays (only the master needs counts).
+        return self._files()
+
     def _scan(self, path):
         """One pass: record count + strided record offsets (+ header)."""
         with open(path, "rb") as f:
@@ -245,6 +250,9 @@ class TextLineDataReader(AbstractDataReader):
             )
         return sorted(p for p in glob.glob(self._data_dir) if os.path.isfile(p))
 
+    def shard_names(self):
+        return self._files()
+
     def _scan(self, path):
         with open(path, "rb") as f:
             count = 0
@@ -299,6 +307,9 @@ class RecordIODataReader(AbstractDataReader):
                 if name.endswith((".rio", ".recordio"))
             )
         return sorted(p for p in glob.glob(self._data_dir) if os.path.isfile(p))
+
+    def shard_names(self):
+        return self._files()
 
     def create_shards(self):
         from elasticdl_tpu.data import recordfile

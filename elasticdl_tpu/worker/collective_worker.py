@@ -52,6 +52,7 @@ class CollectiveWorker:
         validation_data_reader=None,
         prediction_data_reader=None,
         profiler=None,
+        train_window_steps: int = 0,
     ):
         self._mc = master_client
         self._spec = model_spec
@@ -68,6 +69,8 @@ class CollectiveWorker:
         self._last_reported_version = 0
         self._last_ckpt_step = 0
         self._profiler = profiler
+        # Batches per device dispatch (see WINDOW below); 0 = default.
+        self._window_steps = int(train_window_steps) or self.WINDOW
         # Task-type -> reader: evaluation/prediction shards address their
         # own data sources when configured.
         self._readers = {
@@ -262,10 +265,14 @@ class CollectiveWorker:
                 labels, _ = shd.pad_batch(labels, self._block)
             yield features, labels, mask, global_real
 
-    # Batches per device dispatch on the training fast path.  All of a
-    # task's batches share one padded shape, so full windows hit a single
-    # compiled scan program; the tail (< WINDOW batches) reuses the
-    # single-step program — exactly two executables total.
+    # Default batches per device dispatch on the training fast path.  All
+    # of a task's batches share one padded shape, so full windows hit a
+    # single compiled scan program; the tail (< window batches) reuses the
+    # single-step program — exactly two executables total.  Larger windows
+    # amortize the per-dispatch host gap (measured on the PS bench: 8 ->
+    # 400 steps/dispatch recovers ~25% throughput, BASELINE.md) at the
+    # cost of staged-batch memory and checkpoint/report granularity;
+    # --train_window_steps tunes it per job.
     WINDOW = 8
 
     def _process_train_task(self, task) -> dict:
@@ -274,6 +281,26 @@ class CollectiveWorker:
         last_loss = None
         pending: list = []
         pending_real = 0
+        # Clamp the dispatch window to the task's batch count: a window
+        # larger than the task would otherwise never fill, silently
+        # demoting EVERY batch to the per-step path — the opposite of
+        # what a large --train_window_steps asks for.  Equal-size tasks
+        # share the clamped K, so the scan program still compiles once.
+        global_batch = self._block * self._world.world_size
+        task_batches = max(
+            1, -(-(task.end - task.start) // global_batch)
+        )
+        window_steps = min(self._window_steps, task_batches)
+        if window_steps < self._window_steps and self._world.is_leader:
+            logger.info(
+                "Dispatch window clamped %d -> %d (task of %d records "
+                "yields %d global batches; raise --records_per_task to "
+                "use the full window)",
+                self._window_steps,
+                window_steps,
+                task.end - task.start,
+                task_batches,
+            )
 
         def flush():
             nonlocal batch_count, record_count, pending, pending_real, last_loss
@@ -285,7 +312,7 @@ class CollectiveWorker:
                 self._profiler.before_steps(
                     self._trainer.step, len(pending)
                 )
-            if len(pending) == self.WINDOW and hasattr(
+            if len(pending) == window_steps and hasattr(
                 self._trainer, "stage_window"
             ):
                 window = self._trainer.stage_window(pending)
@@ -310,7 +337,7 @@ class CollectiveWorker:
             self._trainer.ensure_initialized(features)
             pending.append((features, labels, mask))
             pending_real += global_real
-            if len(pending) == self.WINDOW:
+            if len(pending) == window_steps:
                 flush()
         flush()
         if last_loss is not None and self._world.is_leader:

@@ -142,6 +142,7 @@ def _build_collective_worker(
         profiler=StepProfiler(
             args.tensorboard_log_dir, args.profile_steps, args.worker_id
         ),
+        train_window_steps=args.train_window_steps,
     )
 
 

@@ -70,5 +70,12 @@ def test_build_refuses_context_overwriting_source(tmp_path, capsys):
         ["build", zoo_dir, "--context", str(tmp_path), "--dockerfile-only"]
     )
     assert rc == 1
-    assert "overwrite the source" in capsys.readouterr().err
+    assert "overwrite or nest" in capsys.readouterr().err
     assert os.path.exists(os.path.join(zoo_dir, "my_model.py"))  # intact
+    # Nested-inside-source case: context under the zoo dir itself.
+    rc = zoo.main(
+        ["build", zoo_dir, "--context", os.path.join(zoo_dir, "ctx"),
+         "--dockerfile-only"]
+    )
+    assert rc == 1
+    assert os.path.exists(os.path.join(zoo_dir, "my_model.py"))
