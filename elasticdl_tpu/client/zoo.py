@@ -110,7 +110,6 @@ def prepare_build_context(
     if not os.path.isdir(zoo_path):
         raise ValueError(f"Model zoo directory not found: {zoo_path}")
     zoo_name = os.path.basename(os.path.normpath(zoo_path))
-    os.makedirs(context_dir, exist_ok=True)
 
     framework_src = os.path.dirname(os.path.abspath(elasticdl_tpu.__file__))
     # Fresh copies: a merged context would keep files deleted from the
@@ -123,15 +122,16 @@ def prepare_build_context(
         # code), and a context NESTED inside a source tree makes copytree
         # copy the destination into itself without terminating.
         real_src, real_dst = os.path.realpath(src), os.path.realpath(dst)
-        if (
-            real_dst == real_src
-            or os.path.commonpath([real_dst, real_src]) == real_src
-        ):
+        common = os.path.commonpath([real_dst, real_src])
+        # Reject equal paths, dst inside src (copytree recursion), AND src
+        # inside dst (rmtree(dst) would delete the user's source).
+        if real_dst == real_src or common in (real_src, real_dst):
             raise ValueError(
                 f"Build context {context_dir!r} would overwrite or nest "
-                f"inside the source directory {src!r}; choose a --context "
+                f"with the source directory {src!r}; choose a --context "
                 "outside the source trees"
             )
+    os.makedirs(context_dir, exist_ok=True)  # after validation: no strays
     shutil.rmtree(framework_dst, ignore_errors=True)
     shutil.rmtree(zoo_dst, ignore_errors=True)
     shutil.copytree(

@@ -222,8 +222,15 @@ class CSVDataReader(AbstractDataReader):
 
     @property
     def metadata(self):
-        if self._columns is None:
-            self.create_shards()
+        if self._columns is None and self._with_header:
+            # Header row of the first file only — never the counting scan
+            # create_shards pays (workers read metadata at boot).
+            files = self._files()
+            if files:
+                with open(files[0], "rb") as f:
+                    self._columns = next(
+                        csv.reader(_ByteLines(f), delimiter=self._sep), None
+                    )
         return Metadata(column_names=self._columns)
 
 

@@ -71,6 +71,11 @@ class CollectiveWorker:
         self._profiler = profiler
         # Batches per device dispatch (see WINDOW below); 0 = default.
         self._window_steps = int(train_window_steps) or self.WINDOW
+        # Pinned from the first task (standard task size) so the job
+        # compiles ONE fused-scan executable; smaller (tail) tasks fall
+        # back to the already-compiled per-step program instead of
+        # compiling a one-off K-step scan per distinct tail size.
+        self._effective_window: Optional[int] = None
         # Task-type -> reader: evaluation/prediction shards address their
         # own data sources when configured.
         self._readers = {
@@ -281,26 +286,34 @@ class CollectiveWorker:
         last_loss = None
         pending: list = []
         pending_real = 0
-        # Clamp the dispatch window to the task's batch count: a window
-        # larger than the task would otherwise never fill, silently
-        # demoting EVERY batch to the per-step path — the opposite of
-        # what a large --train_window_steps asks for.  Equal-size tasks
-        # share the clamped K, so the scan program still compiles once.
-        global_batch = self._block * self._world.world_size
-        task_batches = max(
-            1, -(-(task.end - task.start) // global_batch)
-        )
-        window_steps = min(self._window_steps, task_batches)
-        if window_steps < self._window_steps and self._world.is_leader:
-            logger.info(
-                "Dispatch window clamped %d -> %d (task of %d records "
-                "yields %d global batches; raise --records_per_task to "
-                "use the full window)",
-                self._window_steps,
-                window_steps,
-                task.end - task.start,
-                task_batches,
+        # Effective dispatch window, pinned from the FIRST task: a window
+        # larger than the standard task would otherwise never fill,
+        # silently demoting EVERY batch to the per-step path — the
+        # opposite of what a large --train_window_steps asks for.  The
+        # batch count must mirror iter_local_batch_ranges (per-rank mb x
+        # world, NOT the device-padded block).  Pinning once keeps the
+        # job at one fused-scan executable; smaller tail tasks use the
+        # per-step program rather than compiling one-off scan sizes.
+        if self._effective_window is None:
+            global_batch = self._mb * self._world.world_size
+            task_batches = max(
+                1, -(-(task.end - task.start) // global_batch)
             )
+            self._effective_window = min(self._window_steps, task_batches)
+            if (
+                self._effective_window < self._window_steps
+                and self._world.is_leader
+            ):
+                logger.info(
+                    "Dispatch window clamped %d -> %d (task of %d records "
+                    "yields %d global batches; raise --records_per_task "
+                    "to use the full window)",
+                    self._window_steps,
+                    self._effective_window,
+                    task.end - task.start,
+                    task_batches,
+                )
+        window_steps = self._effective_window
 
         def flush():
             nonlocal batch_count, record_count, pending, pending_real, last_loss

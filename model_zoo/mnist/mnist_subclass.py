@@ -1,0 +1,51 @@
+"""MNIST CNN — subclass-style model-zoo variant.
+
+Parity: model_zoo/mnist/mnist_subclass.py in the reference (the Keras
+model-SUBCLASSING counterpart of the functional-API DNN: a small conv
+net, custom `call`).  Flax's analogue of subclassing is an explicit
+`setup()` module (vs the functional `@nn.compact` the sibling uses) —
+the contract functions are identical, so both import paths work
+anywhere `mnist.mnist_functional_api` does.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from model_zoo.mnist.mnist_functional_api import (  # noqa: F401
+    custom_data_reader,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+
+
+class MnistCNN(nn.Module):
+    """Conv net in setup() style (the reference subclass model was a
+    conv/pool stack, unlike the functional DNN)."""
+
+    hidden_dim: int = 64
+
+    def setup(self):
+        self.conv1 = nn.Conv(16, kernel_size=(3, 3))
+        self.conv2 = nn.Conv(32, kernel_size=(3, 3))
+        self.dense1 = nn.Dense(self.hidden_dim)
+        self.head = nn.Dense(10)
+
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]  # [B, 28, 28] -> [B, 28, 28, 1]
+        x = nn.relu(self.conv1(x))
+        x = nn.avg_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.relu(self.conv2(x))
+        x = nn.avg_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(self.dense1(x))
+        return self.head(x)
+
+
+def custom_model(hidden_dim: int = 64):
+    return MnistCNN(hidden_dim=hidden_dim)

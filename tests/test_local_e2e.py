@@ -61,6 +61,24 @@ def test_local_train_end_to_end(tmp_path):
     assert out.shape == (2, 10) and np.isfinite(out).all()
 
 
+def test_mnist_subclass_variant_trains(tmp_path):
+    """The setup()-style CNN variant (reference: mnist_subclass) runs the
+    same contract end to end."""
+    args = parse_master_args(
+        [
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_subclass",
+            "--distribution_strategy", "Local",
+            "--training_data", "synthetic://mnist?n=256",
+            "--validation_data", "synthetic://mnist?n=64&seed=1",
+            "--records_per_task", "128",
+            "--minibatch_size", "32",
+            "--num_epochs", "1",
+        ]
+    )
+    assert api._run_local(args, mode="training") == 0
+
+
 def test_local_evaluate_only(tmp_path):
     args = parse_master_args(
         [
