@@ -105,6 +105,14 @@ def add_train_arguments(parser: argparse.ArgumentParser):
     parser.add_argument("--output", default="", help="Trained model output path")
     parser.add_argument("--tensorboard_log_dir", default="")
     parser.add_argument(
+        "--dense_sharding", default="replicated",
+        choices=["replicated", "fsdp"],
+        help="Dense param/optimizer placement in AllReduce mode: "
+        "'replicated' (psum gradients) or 'fsdp' (state sharded over the "
+        "data axis — each chip holds 1/N of model+optimizer memory; XLA "
+        "inserts the weight all-gathers / gradient reduce-scatters)",
+    )
+    parser.add_argument(
         "--train_window_steps", type=non_neg_int, default=0,
         help="Training batches fused per device dispatch in cluster "
         "strategies (0 = framework default of 8). Larger windows amortize "

@@ -222,15 +222,24 @@ class CSVDataReader(AbstractDataReader):
 
     @property
     def metadata(self):
-        if self._columns is None and self._with_header:
-            # Header row of the first file only — never the counting scan
-            # create_shards pays (workers read metadata at boot).
-            files = self._files()
-            if files:
-                with open(files[0], "rb") as f:
-                    self._columns = next(
+        if (
+            self._columns is None
+            and self._with_header
+            and not getattr(self, "_header_scanned", False)
+        ):
+            # Header row from the first NON-EMPTY file — never the
+            # counting scan create_shards pays (workers read metadata at
+            # boot).  Scanned-flag caches the no-header outcome so empty
+            # datasets don't re-open files on every access.
+            self._header_scanned = True
+            for path in self._files():
+                with open(path, "rb") as f:
+                    header = next(
                         csv.reader(_ByteLines(f), delimiter=self._sep), None
                     )
+                if header:
+                    self._columns = header
+                    break
         return Metadata(column_names=self._columns)
 
 

@@ -50,9 +50,22 @@ def per_example_loss_fn(loss_fn: Callable) -> Callable:
 class DataParallelTrainer:
     """Same public surface as worker.trainer.Trainer, over an N-device mesh.
 
-    Params/opt-state replicated; batch sharded over `data`; loss is a
-    mask-weighted mean so padded rows contribute zero gradient.
+    Batch sharded over `data`; loss is a mask-weighted mean so padded
+    rows contribute zero gradient.  Dense state placement is selectable
+    (SURVEY.md §5 "dense: replicated or FSDP-sharded"):
+
+    - `dense_sharding="replicated"` (default): params/opt-state replicated;
+      XLA reduces gradients with a psum.
+    - `dense_sharding="fsdp"`: params/opt-state sharded on dim0 over the
+      `data` axis — each chip holds 1/N of the model+optimizer memory.
+      No hand-written gather/scatter: the jit's in/out shardings declare
+      the layout and XLA's SPMD partitioner inserts the all-gathers
+      (weights, before use) and reduce-scatters (gradients) itself,
+      scheduled onto ICI overlapped with compute.  Leaves too small or
+      not divisible by the axis stay replicated.
     """
+
+    FSDP_MIN_LEAF = 1024  # elements; below this, sharding buys nothing
 
     def __init__(
         self,
@@ -61,36 +74,88 @@ class DataParallelTrainer:
         optimizer: optax.GradientTransformation,
         mesh,
         seed: int = 0,
+        dense_sharding: str = "replicated",
     ):
+        if dense_sharding not in ("replicated", "fsdp"):
+            raise ValueError(
+                f"dense_sharding must be 'replicated' or 'fsdp', "
+                f"got {dense_sharding!r}"
+            )
         self._model = model
         self._loss_fn = loss_fn
         self._per_example_loss = per_example_loss_fn(loss_fn)
         self._tx = optimizer
         self._mesh = mesh
         self._seed = seed
+        self._dense_sharding = dense_sharding
         self._state: Optional[TrainState] = None
         # Host-side mirror of state.step (avoids a per-batch device sync).
         self._host_step = 0
         self._dp = shd.data_axis_size(mesh)
+        self._pending_sharded_restore = None
 
-        repl = shd.replicated(mesh)
-        batch = shd.batch_sharded(mesh)
-        window = shd.window_sharded(mesh)
+        # FSDP needs per-leaf state shardings, which need the state's
+        # STRUCTURE — compile lazily at first state (ps_trainer pattern).
+        self._train_step = None
+        self._train_window_jit = None
+        self._eval_step = None
+
+    # -- sharding layout -------------------------------------------------
+
+    def _leaf_sharding(self, leaf):
+        """FSDP placement for one dense leaf: dim0 over the data axis when
+        it divides evenly and the leaf is worth sharding."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elasticdl_tpu.parallel.mesh import DATA_AXIS
+
+        # Works on concrete arrays AND jax.eval_shape's ShapeDtypeStructs
+        # (the sharded-init path computes shardings from shapes alone).
+        shape = tuple(getattr(leaf, "shape", None) or np.shape(leaf))
+        if (
+            self._dense_sharding == "fsdp"
+            and len(shape) >= 1
+            and shape[0] % self._dp == 0
+            and int(np.prod(shape)) >= self.FSDP_MIN_LEAF
+        ):
+            spec = P(DATA_AXIS, *([None] * (len(shape) - 1)))
+            return NamedSharding(self._mesh, spec)
+        return shd.replicated(self._mesh)
+
+    def _state_shardings(self, state: TrainState):
+        repl = shd.replicated(self._mesh)
+        if self._dense_sharding == "replicated":
+            return jax.tree.map(lambda _: repl, state)
+        return TrainState(
+            step=repl,
+            params=jax.tree.map(self._leaf_sharding, state.params),
+            opt_state=jax.tree.map(self._leaf_sharding, state.opt_state),
+            model_state=jax.tree.map(lambda _: repl, state.model_state),
+        )
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        return shd.put(state, self._state_shardings(state))
+
+    def _compile_steps(self, state: TrainState):
+        repl = shd.replicated(self._mesh)
+        batch = shd.batch_sharded(self._mesh)
+        window = shd.window_sharded(self._mesh)
+        state_shardings = self._state_shardings(state)
         self._train_step = jax.jit(
             self._train_step_impl,
-            in_shardings=(repl, batch, batch, batch),
-            out_shardings=(repl, repl),
+            in_shardings=(state_shardings, batch, batch, batch),
+            out_shardings=(state_shardings, repl),
             donate_argnums=(0,),
         )
         self._train_window_jit = jax.jit(
             self._train_window_impl,
-            in_shardings=(repl, window, window, window),
-            out_shardings=(repl, repl),
+            in_shardings=(state_shardings, window, window, window),
+            out_shardings=(state_shardings, repl),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(
             self._eval_step_impl,
-            in_shardings=(repl, batch),
+            in_shardings=(state_shardings, batch),
             out_shardings=batch,
         )
 
@@ -113,42 +178,83 @@ class DataParallelTrainer:
 
     @state.setter
     def state(self, value: TrainState):
-        self._state = shd.put_replicated(value, self._mesh)
+        value = TrainState(*value)
+        self._state = self._place_state(jax.device_get(value))
         self._host_step = int(np.asarray(jax.device_get(value.step)))
+        if self._train_step is None:
+            self._compile_steps(self._state)
 
     @property
     def step(self) -> int:
         return self._host_step
 
+    def _make_state(self, rng, features):
+        """Pure state constructor — runs under jit so FSDP state is BORN
+        sharded (out_shardings), never materialized whole on one device.
+        Returns (state, specs_collection) — the tiny packed-table specs
+        ride out for host-side export mapping."""
+        from elasticdl_tpu.layers.embedding import (
+            SPECS_COLLECTION,
+            strip_capture_collections,
+        )
+        from elasticdl_tpu.worker.trainer import _unbox_partitioned
+
+        variables = dict(self._model.init(rng, features))
+        specs = variables.get(SPECS_COLLECTION, {})
+        variables = strip_capture_collections(variables)
+        variables = _unbox_partitioned(variables)
+        params = variables.pop("params")
+        state = TrainState(
+            jnp.zeros((), jnp.int32),
+            params,
+            self._tx.init(params),
+            variables,
+        )
+        return state, specs
+
     def ensure_initialized(self, features) -> TrainState:
         if self._state is None:
             from elasticdl_tpu.layers.embedding import (
+                SPECS_COLLECTION,
                 export_spec_map,
-                strip_capture_collections,
             )
-            from elasticdl_tpu.worker.trainer import _unbox_partitioned
 
             rng = jax.random.PRNGKey(self._seed)
-            variables = dict(
-                self._model.init(rng, jax.tree.map(jnp.asarray, features))
+            features = jax.tree.map(jnp.asarray, features)
+            # Structure first (no FLOPs, no memory), shardings from it,
+            # then a jitted init whose out_shardings birth the state in
+            # its final layout — under FSDP no device ever holds the
+            # full params+opt_state (the point of sharding them).
+            state_shapes, _specs_shapes = jax.eval_shape(
+                self._make_state, rng, features
             )
-            self._export_specs = export_spec_map(variables)
-            variables = strip_capture_collections(variables)
-            variables = _unbox_partitioned(variables)
-            params = variables.pop("params")
-            state = TrainState(
-                jnp.zeros((), jnp.int32),
-                params,
-                self._tx.init(params),
-                variables,
+            shardings = self._state_shardings(state_shapes)
+            repl = shd.replicated(self._mesh)
+            init = jax.jit(
+                self._make_state,
+                out_shardings=(
+                    shardings,
+                    jax.tree.map(lambda _: repl, _specs_shapes),
+                ),
             )
-            self._state = shd.put_replicated(jax.device_get(state), self._mesh)
+            self._state, specs = init(rng, features)
+            self._export_specs = export_spec_map(
+                {SPECS_COLLECTION: jax.device_get(specs)}
+            )
             logger.info(
-                "Initialized replicated model over %d-way data parallel: "
+                "Initialized %s model over %d-way data parallel: "
                 "%d parameters",
+                self._dense_sharding,
                 self._dp,
-                sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params)),
+                sum(
+                    int(np.prod(p.shape))
+                    for p in jax.tree.leaves(state_shapes.params)
+                ),
             )
+        if self._pending_sharded_restore is not None:
+            self._state = self._restore_sharded(self._state)
+        if self._train_step is None:
+            self._compile_steps(self._state)
         return self._state
 
     # -- compiled steps -------------------------------------------------
@@ -270,12 +376,90 @@ class DataParallelTrainer:
         return jax.tree.map(lambda x: np.asarray(x)[:n], outputs)
 
     def state_to_host(self) -> Optional[TrainState]:
-        """Host-complete snapshot for checkpointing.  All state is fully
-        replicated, so every process can materialize it locally."""
-        return None if self._state is None else jax.device_get(self._state)
+        """Host-complete snapshot for checkpointing.  Replicated state
+        materializes locally; FSDP-sharded leaves allgather — a COLLECTIVE
+        in multi-process worlds (every process must call this).  FSDP jobs
+        normally checkpoint via save_checkpoint (shard-wise, no gather);
+        this full-gather remains for export/debug paths."""
+        if self._state is None:
+            return None
+        if self._dense_sharding == "replicated":
+            return jax.device_get(self._state)
+        return shd.gather_to_host(self._state)
+
+    # -- sharded checkpointing (FSDP) -----------------------------------
+
+    @staticmethod
+    def _leaf_key(path) -> str:
+        return "dense|" + "/".join(str(getattr(p, "key", p)) for p in path)
+
+    def save_checkpoint(self, saver, step: int) -> None:
+        """COLLECTIVE shard-wise checkpoint (checkpoint/sharded.py):
+        each process writes only its local rows of FSDP-sharded leaves —
+        no host ever gathers the full model+optimizer state (which is
+        the thing FSDP exists to avoid holding)."""
+        if self._state is None:
+            return
+        state = self._state
+        shardings = self._state_shardings(state)
+        flat_state = jax.tree_util.tree_flatten_with_path(state)[0]
+        flat_shard = jax.tree.leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "is_fully_replicated")
+        )
+        sharded = {}
+        dense_leaves = {}
+        for (path, leaf), sharding in zip(flat_state, flat_shard):
+            key = self._leaf_key(path)
+            if sharding.is_fully_replicated:
+                if jax.process_index() == 0:
+                    dense_leaves[key] = jax.device_get(leaf)
+            else:
+                sharded[key] = leaf
+        dense = None
+        if jax.process_index() == 0:
+            dense = {
+                "step": int(self._host_step),
+                "leaves": dense_leaves,
+            }
+        saver.save(step, dense, sharded)
+
+    def set_sharded_restore(self, saver, step: int) -> None:
+        self._pending_sharded_restore = (saver, step)
+        self._host_step = step
+
+    def _restore_sharded(self, template: TrainState) -> TrainState:
+        saver, step = self._pending_sharded_restore
+        self._pending_sharded_restore = None
+        shardings = self._state_shardings(template)
+        manifest_arrays = saver.manifest(step)["arrays"]
+        dense = saver.load_dense(step)
+        flat_template, treedef = jax.tree_util.tree_flatten_with_path(
+            template
+        )
+        flat_shard = jax.tree.leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "is_fully_replicated")
+        )
+        leaves = []
+        for (path, leaf), sharding in zip(flat_template, flat_shard):
+            key = self._leaf_key(path)
+            if key in manifest_arrays:
+                leaves.append(saver.load_array(step, key, sharding))
+            elif key in dense["leaves"]:
+                leaves.append(shd.put(dense["leaves"][key], sharding))
+            else:
+                raise KeyError(
+                    f"Checkpoint at step {step} missing leaf {key} "
+                    "(model structure changed?)"
+                )
+        if hasattr(saver, "release"):
+            saver.release(step)
+        self._host_step = int(dense["step"])
+        logger.info("Restored sharded checkpoint at step %d", self._host_step)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
     def get_variables_numpy(self) -> dict:
-        """Flat logical view; packed tables unpacked (see worker.trainer)."""
+        """Flat logical view; packed tables unpacked (see worker.trainer).
+        COLLECTIVE under FSDP in multi-process worlds (see state_to_host)."""
         from elasticdl_tpu.parallel import packed as pk
 
         if self._state is None:
@@ -283,6 +467,8 @@ class DataParallelTrainer:
         specs = getattr(self, "_export_specs", {})
         flat = {}
         tree = {"params": self._state.params, **self._state.model_state}
+        if self._dense_sharding == "fsdp":
+            tree = shd.gather_to_host(tree)
         for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
             key = "/".join(str(getattr(p, "key", p)) for p in path)
             if key in specs:

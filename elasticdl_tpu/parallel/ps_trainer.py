@@ -172,17 +172,10 @@ class ShardedEmbeddingTrainer:
 
     @staticmethod
     def _place_leaf(x, s):
-        return (
-            jax.device_put(x, s)
-            if jax.process_count() == 1
-            else jax.make_array_from_callback(
-                np.shape(x), s, lambda idx, _x=np.asarray(x): _x[idx]
-            )
-        )
+        return shd.put(x, s)
 
     def _place_state(self, state: PSTrainState) -> PSTrainState:
-        shardings = self._state_shardings(state)
-        return jax.tree.map(self._place_leaf, state, shardings)
+        return shd.put(state, self._state_shardings(state))
 
     # -- initialization -------------------------------------------------
 

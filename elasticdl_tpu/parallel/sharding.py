@@ -81,22 +81,24 @@ def shard_batch(tree: Any, mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
-def put_replicated(tree: Any, mesh):
-    """Replicate a host pytree over the mesh — works in multi-process
-    worlds too (each process places its local shards from its own copy)."""
+def put(tree: Any, shardings: Any):
+    """Place a host pytree under per-leaf shardings — works in
+    multi-process worlds too (each process materializes its local shards
+    from its own host copy, which must hold the GLOBAL value)."""
     import jax
 
-    sharding = replicated(mesh)
     if jax.process_count() == 1:
-        return jax.device_put(tree, sharding)
+        return jax.tree.map(jax.device_put, tree, shardings)
 
-    def put(x):
+    def place(x, s):
         arr = np.asarray(x)
         return jax.make_array_from_callback(
-            arr.shape, sharding, lambda idx: arr[idx]
+            arr.shape, s, lambda idx: arr[idx]
         )
 
-    return jax.tree.map(put, tree)
+    return jax.tree.map(place, tree, shardings)
+
+
 
 
 def assemble_global_batch(tree: Any, mesh):

@@ -116,8 +116,22 @@ def export_model(
     write = jax.process_index() == 0
     if write:
         os.makedirs(out_dir, exist_ok=True)
-    params = jax.device_get(state.params)
-    model_state = jax.device_get(state.model_state)
+    if hasattr(state, "tables"):
+        # PS mode: dense params are replicated (tables handled below).
+        params = jax.device_get(state.params)
+        model_state = jax.device_get(state.model_state)
+    else:
+        # Gather ONLY what serving needs (params + batch stats) — never
+        # the optimizer state, which doubles-or-triples the transfer for
+        # nothing.  gather_to_host is a collective for FSDP-sharded
+        # leaves and a plain host fetch for replicated/local state.
+        from elasticdl_tpu.parallel import sharding as _shd
+
+        host = _shd.gather_to_host(
+            {"params": state.params, "model_state": state.model_state}
+        )
+        params = host["params"]
+        model_state = host["model_state"]
     # Unfreeze so table placeholders can be replaced by refs in place.
     params = jax.tree.map(lambda x: x, params)
 

@@ -286,30 +286,28 @@ class CollectiveWorker:
         last_loss = None
         pending: list = []
         pending_real = 0
-        # Effective dispatch window, pinned from the FIRST task: a window
-        # larger than the standard task would otherwise never fill,
-        # silently demoting EVERY batch to the per-step path — the
-        # opposite of what a large --train_window_steps asks for.  The
-        # batch count must mirror iter_local_batch_ranges (per-rank mb x
-        # world, NOT the device-padded block).  Pinning once keeps the
-        # job at one fused-scan executable; smaller tail tasks use the
-        # per-step program rather than compiling one-off scan sizes.
-        if self._effective_window is None:
-            global_batch = self._mb * self._world.world_size
-            task_batches = max(
-                1, -(-(task.end - task.start) // global_batch)
-            )
-            self._effective_window = min(self._window_steps, task_batches)
-            if (
-                self._effective_window < self._window_steps
-                and self._world.is_leader
-            ):
+        # Effective dispatch window: a window larger than the task would
+        # never fill, silently demoting EVERY batch to the per-step path
+        # — the opposite of what a large --train_window_steps asks for.
+        # The batch count mirrors iter_local_batch_ranges (per-rank mb x
+        # world, NOT the device-padded block).  The window RATCHETS
+        # upward: it grows to the largest min(configured, task_batches)
+        # seen, so a small first task (ragged shard head) can't pin the
+        # whole job to per-step, while tasks smaller than the ratchet use
+        # the per-step program instead of compiling one-off scan sizes —
+        # executables stay bounded by the few distinct upward steps.
+        global_batch = self._mb * self._world.world_size
+        task_batches = max(1, -(-(task.end - task.start) // global_batch))
+        candidate = min(self._window_steps, task_batches)
+        if self._effective_window is None or candidate > self._effective_window:
+            self._effective_window = candidate
+            if candidate < self._window_steps and self._world.is_leader:
                 logger.info(
                     "Dispatch window clamped %d -> %d (task of %d records "
                     "yields %d global batches; raise --records_per_task "
                     "to use the full window)",
                     self._window_steps,
-                    self._effective_window,
+                    candidate,
                     task.end - task.start,
                     task_batches,
                 )

@@ -111,12 +111,17 @@ def _build_collective_worker(
             loss_fn=model_spec.loss,
             optimizer=model_spec.optimizer(),
             mesh=mesh,
+            dense_sharding=args.dense_sharding,
         )
     saver = None
     if args.checkpoint_dir:
-        if args.distribution_strategy == "ParameterServerStrategy":
-            # PS tables are mesh-sharded: per-process shard files, so no
-            # host ever gathers a full table (checkpoint/sharded.py).
+        if (
+            args.distribution_strategy == "ParameterServerStrategy"
+            or args.dense_sharding == "fsdp"
+        ):
+            # Mesh-sharded state (PS tables / FSDP dense leaves): each
+            # process writes its own shard files, so no host ever gathers
+            # the full model (checkpoint/sharded.py).
             from elasticdl_tpu.checkpoint import ShardedCheckpointSaver
 
             saver = ShardedCheckpointSaver(

@@ -1,0 +1,137 @@
+"""FSDP dense-sharding tests (SURVEY.md §5: "dense: replicated or
+FSDP-sharded").  The 8-virtual-device mesh verifies that sharded state
+really spans devices, trains equivalently to replicated mode, and
+round-trips checkpoints/export."""
+
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.parallel import MeshConfig, build_mesh
+from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+
+
+def _model():
+    from model_zoo.mnist import mnist_functional_api as zoo
+
+    return zoo
+
+
+def _batches(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.rand(n, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, size=n).astype(np.int32)
+    return images, labels
+
+
+def _trainer(dense_sharding):
+    zoo = _model()
+    mesh = build_mesh(MeshConfig())
+    return DataParallelTrainer(
+        zoo.custom_model(),
+        zoo.loss,
+        optax.sgd(0.1, momentum=0.9),
+        mesh,
+        seed=0,
+        dense_sharding=dense_sharding,
+    )
+
+
+def test_fsdp_state_actually_shards():
+    trainer = _trainer("fsdp")
+    images, labels = _batches()
+    trainer.ensure_initialized(images[:16])
+    state = trainer.state
+    # The big dense kernels span all 8 devices with 1/8 per device...
+    big = [
+        p for p in __import__("jax").tree.leaves(state.params)
+        if p.size >= DataParallelTrainer.FSDP_MIN_LEAF
+        and p.shape[0] % 8 == 0
+    ]
+    assert big, "test model has no shardable leaves"
+    for leaf in big:
+        assert len(leaf.sharding.device_set) == 8
+        shard = leaf.addressable_shards[0]
+        assert shard.data.size == leaf.size // 8
+    # ...and scalars/small leaves stay replicated.
+    step_shard = state.step.addressable_shards[0]
+    assert step_shard.data.size == state.step.size
+
+
+def test_fsdp_trains_equivalently_to_replicated():
+    images, labels = _batches(n=16)
+    losses = {}
+    for mode in ("replicated", "fsdp"):
+        trainer = _trainer(mode)
+        # Same batch each step: the random data is memorizable, so the
+        # loss must fall — and both layouts must fall IDENTICALLY.
+        losses[mode] = [
+            float(trainer.train_step(images, labels)) for _ in range(6)
+        ]
+    np.testing.assert_allclose(
+        losses["replicated"], losses["fsdp"], rtol=2e-4
+    )
+    assert losses["fsdp"][-1] < losses["fsdp"][0]  # it actually learns
+
+
+def test_fsdp_checkpoint_roundtrip_and_export(tmp_path):
+    images, labels = _batches(n=32)
+    t1 = _trainer("fsdp")
+    for i in range(2):
+        t1.train_step(images[i * 16 : (i + 1) * 16], labels[i * 16 :][:16])
+    host = t1.state_to_host()
+    # Host snapshot is complete (gathered), numpy, full-shape.
+    first = np.asarray(__import__("jax").tree.leaves(host.params)[0])
+    assert first.ndim >= 1
+
+    t2 = _trainer("fsdp")
+    t2.state = host  # restore re-shards under the fsdp layout
+    l1 = float(t1.train_step(images[:16], labels[:16]))
+    l2 = float(t2.train_step(images[:16], labels[:16]))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    # Export gathers sharded params into a servable artifact.
+    from elasticdl_tpu.serving import export_model, load_for_serving
+
+    out = str(tmp_path / "export")
+    export_model(
+        t1, out,
+        model_zoo="model_zoo",
+        model_def="mnist.mnist_functional_api",
+    )
+    served = load_for_serving(out)
+    pred = np.asarray(served.predict(images[:4]))
+    assert pred.shape == (4, 10) and np.isfinite(pred).all()
+
+
+def test_fsdp_sharded_checkpoint_roundtrip(tmp_path):
+    """FSDP jobs checkpoint shard-wise: sharded leaves write their row
+    intervals, replicated leaves write once, no full-model gather — and
+    restore rebuilds identical training state."""
+    import json
+
+    from elasticdl_tpu.checkpoint import ShardedCheckpointSaver
+
+    images, labels = _batches(n=16)
+    t1 = _trainer("fsdp")
+    for _ in range(3):
+        t1.train_step(images, labels)
+    saver = ShardedCheckpointSaver(str(tmp_path))
+    t1.save_checkpoint(saver, t1.step)
+
+    manifest = json.loads(
+        (tmp_path / "step_000000000003" / "manifest.json").read_text()
+    )
+    assert any(k.startswith("dense|") for k in manifest["arrays"])
+
+    t2 = _trainer("fsdp")
+    t2.set_sharded_restore(saver, 3)
+    assert t2.step == 3
+    l1 = float(t1.train_step(images, labels))
+    l2 = float(t2.train_step(images, labels))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="dense_sharding"):
+        _trainer("zero3")
