@@ -99,10 +99,13 @@ def bench_deepfm(
     def run_window(i: int) -> float:
         start = time.perf_counter()
         losses = trainer.train_window(windows[i % 2])
-        # Block on BOTH outputs: blocking on a single scalar leaf has been
-        # observed to return before the full program completes on the
-        # tunneled backend.
-        jax.block_until_ready((losses, trainer.state))
+        # Force with a device->host COPY, not block_until_ready: on the
+        # tunneled backend block_until_ready has been observed to return
+        # milliseconds into a multi-hundred-ms program (both on single
+        # leaves and whole pytrees); materializing the losses on host
+        # cannot lie — the program must have finished to produce them.
+        host_losses = np.asarray(losses)
+        assert np.isfinite(host_losses).all()
         return time.perf_counter() - start
 
     run_window(0)  # warmup: compile + first-touch
@@ -153,7 +156,9 @@ def bench_resnet50(
     def run_window(i: int) -> float:
         start = time.perf_counter()
         losses = trainer.train_window(window)
-        jax.block_until_ready((losses, trainer.state))
+        # Device->host copy as the completion fence (see bench_deepfm).
+        host_losses = np.asarray(losses)
+        assert np.isfinite(host_losses).all()
         return time.perf_counter() - start
 
     run_window(0)  # warmup: compile + first-touch

@@ -230,14 +230,25 @@ class DataParallelTrainer:
             )
             shardings = self._state_shardings(state_shapes)
             repl = shd.replicated(self._mesh)
-            init = jax.jit(
-                self._make_state,
-                out_shardings=(
-                    shardings,
-                    jax.tree.map(lambda _: repl, _specs_shapes),
-                ),
-            )
-            self._state, specs = init(rng, features)
+            if self._pending_sharded_restore is not None:
+                # Restore path: the checkpoint supplies every value, so
+                # never run (or even compile) the full init — the shape
+                # tree is template enough, and the tiny export specs come
+                # from a specs-only jit whose unused param computations
+                # XLA dead-code-eliminates.
+                specs = jax.jit(
+                    lambda r, f: self._make_state(r, f)[1]
+                )(rng, features)
+                self._state = self._restore_sharded(state_shapes)
+            else:
+                init = jax.jit(
+                    self._make_state,
+                    out_shardings=(
+                        shardings,
+                        jax.tree.map(lambda _: repl, _specs_shapes),
+                    ),
+                )
+                self._state, specs = init(rng, features)
             self._export_specs = export_spec_map(
                 {SPECS_COLLECTION: jax.device_get(specs)}
             )
@@ -252,6 +263,8 @@ class DataParallelTrainer:
                 ),
             )
         if self._pending_sharded_restore is not None:
+            # State arrived via the setter (or was already live) after a
+            # deferred restore was registered: apply it now.
             self._state = self._restore_sharded(self._state)
         if self._train_step is None:
             self._compile_steps(self._state)
