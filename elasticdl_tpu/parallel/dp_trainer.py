@@ -228,8 +228,6 @@ class DataParallelTrainer:
             state_shapes, _specs_shapes = jax.eval_shape(
                 self._make_state, rng, features
             )
-            shardings = self._state_shardings(state_shapes)
-            repl = shd.replicated(self._mesh)
             if self._pending_sharded_restore is not None:
                 # Restore path: the checkpoint supplies every value, so
                 # never run (or even compile) the full init — the shape
@@ -241,10 +239,11 @@ class DataParallelTrainer:
                 )(rng, features)
                 self._state = self._restore_sharded(state_shapes)
             else:
+                repl = shd.replicated(self._mesh)
                 init = jax.jit(
                     self._make_state,
                     out_shardings=(
-                        shardings,
+                        self._state_shardings(state_shapes),
                         jax.tree.map(lambda _: repl, _specs_shapes),
                     ),
                 )

@@ -24,6 +24,74 @@ WORKER_ENV = {
 }
 
 
+def test_ps_mode_kill_worker_restores_sharded_checkpoint(tmp_path):
+    """The flagship elastic-restore path end to end: a 2-process PS world
+    checkpoints shard-wise (shards_p0of2 + shards_p1of2), a worker is
+    killed with the restart budget exhausted, and the re-formed
+    1-process world restores the SAME shard files under its new sharding
+    (world-size-agnostic restore) and finishes every record."""
+    n_records = 1024
+    args = parse_master_args([
+        "--model_zoo=model_zoo",
+        "--model_def=deepfm.deepfm_functional_api",
+        f"--training_data=synthetic://criteo?n={n_records}&vocab=100",
+        "--model_params=vocab_size=100",
+        "--records_per_task=128",
+        "--minibatch_size=4",
+        "--num_workers=2",
+        "--distribution_strategy=ParameterServerStrategy",
+        f"--checkpoint_dir={tmp_path / 'ckpt'}",
+        "--checkpoint_steps=8",
+    ])
+    rendezvous = ElasticRendezvous()
+    master = start_master(args, rendezvous_server=rendezvous)
+    manager = LocalProcessManager(
+        num_workers=2,
+        worker_argv_fn=worker_argv_from_args(args, master.addr),
+        rendezvous=rendezvous,
+        task_manager=master.task_manager,
+        max_restarts=0,
+        worker_env=WORKER_ENV,
+        log_dir=str(tmp_path / "logs"),
+        job_finished_fn=master.task_manager.finished,
+    )
+    import time
+
+    try:
+        manager.start()
+        # Wait for real progress AND a 2-process sharded checkpoint.
+        deadline = time.time() + 300
+        def two_proc_ckpt():
+            root = tmp_path / "ckpt"
+            if not root.exists():
+                return False
+            return any(
+                (root / d / "shards_p1of2.npz").exists()
+                for d in os.listdir(root)
+                if d.startswith("step_") and ".tmp" not in d
+            )
+        while not two_proc_ckpt():
+            assert time.time() < deadline, "no 2-proc checkpoint written"
+            assert not master.task_manager.finished(), "finished too fast"
+            time.sleep(0.1)
+        victims = manager.current_worker_ids()
+        manager.kill_worker(victims[1])
+        assert manager.wait(timeout=480) is True
+        assert master.task_manager.finished()
+        assert master.task_manager.finished_record_count == n_records
+        # The world actually shrank and trained on after restoring the
+        # 2-process checkpoint into a 1-process layout.
+        assert len(manager.current_worker_ids()) == 1
+        logs = "".join(
+            open(os.path.join(tmp_path / "logs", f)).read()
+            for f in os.listdir(tmp_path / "logs")
+        )
+        assert "restore sharded checkpoint" in logs
+    finally:
+        manager.stop()
+        master.stop()
+
+
 def test_ps_mode_two_workers_two_devices_each(tmp_path):
     """2 processes x 2 virtual devices: tables shard across FOUR devices
     spanning process boundaries — the closest the CPU harness gets to the
