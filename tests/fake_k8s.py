@@ -31,15 +31,24 @@ def _match_selector(labels: Dict[str, str], selector: str) -> bool:
 
 
 class FakeK8sApiServer:
-    def __init__(self, auto_run: bool = True):
+    def __init__(self, auto_run: bool = True, watch_max_events: int = 0):
         self.auto_run = auto_run
         self.schedulable = True
+        # Chaos knob: close every watch stream after this many events
+        # (0 = never), forcing clients through their reconnect path.
+        self.watch_max_events = watch_max_events
         self._lock = threading.Lock()
         self._pods: Dict[str, dict] = {}
         self._rv = 0
         self._watchers: List[queue.Queue] = []
         self._uid = 0
         self.create_log: List[str] = []
+        # Ordered (rv, event) history so watches with a resourceVersion
+        # resume from where they left off (real apiserver semantics — a
+        # reconnecting client must not miss events); bounded, with 410
+        # Gone for clients whose rv fell off the end.
+        self._event_log: List[tuple] = []
+        self.event_log_cap = 1000
 
         server = self
 
@@ -73,7 +82,11 @@ class FakeK8sApiServer:
                     return
                 selector = q.get("labelSelector", "")
                 if q.get("watch") == "true":
-                    self._watch(selector, float(q.get("timeoutSeconds", 30)))
+                    self._watch(
+                        selector,
+                        float(q.get("timeoutSeconds", 30)),
+                        q.get("resourceVersion", ""),
+                    )
                     return
                 self._send_json(
                     {
@@ -83,16 +96,31 @@ class FakeK8sApiServer:
                     }
                 )
 
-            def _watch(self, selector: str, timeout_s: float):
+            def _watch(self, selector: str, timeout_s: float, rv: str):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.end_headers()
                 events = queue.Queue()
-                # Like list-then-watch collapsed: current state first.
-                for pod in server.list_pods(selector):
-                    events.put({"type": "ADDED", "object": pod})
-                server._add_watcher(events)
+                if rv:
+                    # Resume: replay history AFTER rv + register for live
+                    # events in ONE atomic step (no gap), or 410 if the
+                    # log no longer reaches back to rv.
+                    if server._resume_watcher(int(rv), events) is None:
+                        self.wfile.write(
+                            (json.dumps({
+                                "type": "ERROR",
+                                "object": {"kind": "Status", "code": 410},
+                            }) + "\n").encode()
+                        )
+                        self.wfile.flush()
+                        return
+                else:
+                    # Like list-then-watch collapsed: current state first.
+                    for pod in server.list_pods(selector):
+                        events.put({"type": "ADDED", "object": pod})
+                    server._add_watcher(events)
                 deadline = time.time() + timeout_s
+                sent = 0
                 try:
                     while time.time() < deadline:
                         try:
@@ -107,6 +135,12 @@ class FakeK8sApiServer:
                             (json.dumps(event) + "\n").encode()
                         )
                         self.wfile.flush()
+                        sent += 1
+                        if (
+                            server.watch_max_events
+                            and sent >= server.watch_max_events
+                        ):
+                            return  # chaos: drop the stream mid-watch
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 finally:
@@ -165,8 +199,23 @@ class FakeK8sApiServer:
 
     def _broadcast_locked(self, etype: str, pod: dict):
         event = {"type": etype, "object": json.loads(json.dumps(pod))}
+        self._event_log.append((self._rv, event))
+        del self._event_log[: -self.event_log_cap]
         for q in self._watchers:
             q.put(event)
+
+    def _resume_watcher(self, rv: int, q: queue.Queue):
+        """Atomically replay history after `rv` into `q` and register it
+        for live events; None when the log no longer reaches back to `rv`
+        (real 410 Gone semantics)."""
+        with self._lock:
+            if self._event_log and rv < self._event_log[0][0] - 1:
+                return None
+            for r, event in self._event_log:
+                if r > rv:
+                    q.put(event)
+            self._watchers.append(q)
+            return True
 
     def create_pod(self, manifest: dict) -> dict:
         with self._lock:

@@ -375,6 +375,45 @@ def test_pod_manager_pending_timeout_is_churn(client, fake_k8s):
         manager.stop()
 
 
+@pytest.mark.parametrize(
+    "event_log_cap",
+    [
+        pytest.param(0, id="streams-drop-resume-from-rv"),
+        pytest.param(1, id="resume-gets-410-re-list"),
+    ],
+)
+def test_pod_manager_survives_watch_stream_chaos(event_log_cap):
+    """Every watch connection dies after ONE event: the manager must keep
+    reconnecting and still see churn, re-form, and finish — the lifecycle
+    must never depend on a long-lived stream.  With the server's event
+    log capped at 1, most resumes additionally get 410 Gone, forcing the
+    WatchExpired -> full re-list recovery path every time."""
+    server = FakeK8sApiServer(watch_max_events=1).start()
+    if event_log_cap:
+        server.event_log_cap = event_log_cap
+    try:
+        chaos_client = K8sClient(
+            K8sConfig(host=server.host, namespace="testns")
+        )
+        manager, tm = _manager(chaos_client, server, n=2)
+        manager.start()
+        try:
+            _wait_for(lambda: len(server.pod_names()) == 2, msg="world 1")
+            server.fail_pod(pod_name("testjob", "worker", 0))
+            _wait_for(
+                lambda: sorted(manager.current_worker_ids()) == [2, 3],
+                msg="re-formed world despite dropping watches",
+                timeout=30,
+            )
+            assert sorted(tm.recovered) == [0, 1]
+            server.succeed_all()
+            assert manager.wait(timeout=15)
+        finally:
+            manager.stop()
+    finally:
+        server.stop()
+
+
 def test_pod_manager_sweeps_leftover_pods(client, fake_k8s):
     """A new master incarnation deletes its predecessor's worker pods
     before world 1 — pod names would otherwise collide and 409s would be

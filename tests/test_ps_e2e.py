@@ -6,6 +6,7 @@ collective checkpoint gather, none of which single-process tests can see.
 """
 
 import os
+import time
 
 import numpy as np
 
@@ -55,8 +56,6 @@ def test_ps_mode_kill_worker_restores_sharded_checkpoint(tmp_path):
         log_dir=str(tmp_path / "logs"),
         job_finished_fn=master.task_manager.finished,
     )
-    import time
-
     try:
         manager.start()
         # Wait for real progress AND a 2-process sharded checkpoint.
