@@ -7,3 +7,14 @@ from elasticdl_tpu.preprocessing.layers import (  # noqa: F401
     RoundIdentity,
     to_padded_ids,
 )
+from elasticdl_tpu.preprocessing.feature_column import (  # noqa: F401
+    FeatureLayer,
+    bucketized_column,
+    categorical_column_with_hash_bucket,
+    categorical_column_with_identity,
+    categorical_column_with_vocabulary_list,
+    crossed_column,
+    embedding_column,
+    numeric_column,
+    shared_embedding_columns,
+)

@@ -42,13 +42,14 @@ class BottleneckBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: Dtype = jnp.bfloat16
+    norm_dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=jnp.float32,
+            epsilon=1e-5, dtype=self.norm_dtype,
         )
         residual = x
         y = conv(self.filters, (1, 1))(x)
@@ -72,6 +73,7 @@ class BottleneckBlock(nn.Module):
 class ResNet50(nn.Module):
     num_classes: int = NUM_CLASSES
     dtype: Dtype = jnp.bfloat16
+    norm_dtype: Dtype = jnp.float32
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
 
     @nn.compact
@@ -83,7 +85,7 @@ class ResNet50(nn.Module):
         )(x)
         x = nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
-            dtype=jnp.float32,
+            dtype=self.norm_dtype,
         )(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
@@ -91,7 +93,9 @@ class ResNet50(nn.Module):
             filters = 64 * (2 ** stage)
             for block in range(blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
-                x = BottleneckBlock(filters, strides, self.dtype)(x, train=train)
+                x = BottleneckBlock(
+                    filters, strides, self.dtype, self.norm_dtype
+                )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
 

@@ -1,0 +1,74 @@
+"""Perf experiment: ResNet-50 step-time knobs on the real chip.
+
+Not part of the test suite — a measurement harness for BASELINE.md numbers.
+Usage: python scripts/exp_resnet_perf.py b512_w4 b512_w8_bf16in ...
+
+Variant tokens (joined by `_`): bN = batch, wN = steps/window,
+`bf16in` = stage images as bfloat16, `normf32` = f32 BN compute.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+
+def run_variant(spec: str):
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+    from model_zoo.resnet50 import resnet50_subclass as zoo
+
+    batch, steps, in_dtype, norm_dtype = 512, 4, np.float32, jnp.bfloat16
+    for tok in spec.split("_"):
+        if tok.startswith("b") and tok[1:].isdigit():
+            batch = int(tok[1:])
+        elif tok.startswith("w") and tok[1:].isdigit():
+            steps = int(tok[1:])
+        elif tok == "bf16in":
+            in_dtype = ml_dtypes.bfloat16
+        elif tok == "normf32":
+            norm_dtype = jnp.float32
+        else:
+            raise SystemExit(f"unknown token {tok} in {spec}")
+
+    model = zoo.ResNet50(dtype=jnp.bfloat16, norm_dtype=norm_dtype)
+    mesh = build_mesh(MeshConfig())
+    trainer = DataParallelTrainer(model, zoo.loss, zoo.optimizer(), mesh)
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        images = rng.rand(batch, 224, 224, 3).astype(in_dtype)
+        labels = rng.randint(0, 1000, size=batch).astype(np.int32)
+        return images, labels, np.ones((batch,), np.float32)
+
+    window = trainer.stage_window([make_batch() for _ in range(steps)])
+
+    def run():
+        start = time.perf_counter()
+        losses = trainer.train_window(window)
+        np.asarray(losses)
+        return time.perf_counter() - start
+
+    run(); run()
+    times = [run() for _ in range(5)]
+    rates = sorted(batch * steps / t for t in times)
+    med = rates[len(rates) // 2]
+    spread = (rates[-1] - rates[0]) / med
+    print(f"{spec}: {med:,.0f} img/s (spread {spread:.1%})", flush=True)
+
+
+def main():
+    for spec in sys.argv[1:] or ["b512_w4"]:
+        try:
+            run_variant(spec)
+        except Exception as e:
+            print(f"{spec}: FAILED {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Capture a jax.profiler trace of one benchmark window and print the
+top device ops by total duration.
+
+Measurement harness for BASELINE.md's profiler-trace notes (not a test).
+Usage:
+    python scripts/profile_summary.py resnet50 [--batch 512]
+    python scripts/profile_summary.py deepfm [--batch 8192]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import tempfile
+from collections import defaultdict
+
+import numpy as np
+
+
+def summarize_xplane(logdir: str, top: int = 25):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(
+        os.path.join(logdir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not paths:
+        print("no xplane files under", logdir)
+        return
+    for path in paths:
+        with open(path, "rb") as f:
+            space = xplane_pb2.XSpace.FromString(f.read())
+        for plane in space.planes:
+            if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+                continue
+            metadata = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+            totals = defaultdict(float)
+            counts = defaultdict(int)
+            for line in plane.lines:
+                # XLA op lines carry the per-op device activity.
+                for event in line.events:
+                    name = metadata.get(event.metadata_id, "?")
+                    totals[name] += event.duration_ps / 1e9  # -> ms
+                    counts[name] += 1
+            if not totals:
+                continue
+            print(f"\n== plane: {plane.name} (lines: {len(plane.lines)}) ==")
+            ranked = sorted(totals.items(), key=lambda kv: -kv[1])
+            total_ms = sum(totals.values())
+            print(f"total device-event time {total_ms:.1f} ms (double-counts nested lines)")
+            for name, ms in ranked[:top]:
+                print(f"  {ms:9.2f} ms  x{counts[name]:<5d} {name[:110]}")
+
+
+def run_resnet(batch: int, logdir: str, norm_bf16: bool = True):
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+    from model_zoo.resnet50 import resnet50_subclass as zoo
+
+    model = zoo.ResNet50(
+        dtype=jnp.bfloat16,
+        norm_dtype=jnp.bfloat16 if norm_bf16 else jnp.float32,
+    )
+    mesh = build_mesh(MeshConfig())
+    trainer = DataParallelTrainer(model, zoo.loss, zoo.optimizer(), mesh)
+    rng = np.random.RandomState(0)
+    batches = [
+        (
+            rng.rand(batch, 224, 224, 3).astype(np.float32),
+            rng.randint(0, 1000, size=batch).astype(np.int32),
+            np.ones((batch,), np.float32),
+        )
+        for _ in range(4)
+    ]
+    window = trainer.stage_window(batches)
+    np.asarray(trainer.train_window(window))  # compile + warm
+    np.asarray(trainer.train_window(window))
+    with jax.profiler.trace(logdir):
+        np.asarray(trainer.train_window(window))
+
+
+def run_deepfm(batch: int, logdir: str, steps: int = 40):
+    import jax
+
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    vocab = 100_000
+    mesh = build_mesh(MeshConfig())
+    trainer = ShardedEmbeddingTrainer(
+        zoo.custom_model(vocab_size=vocab),
+        zoo.loss,
+        zoo.optimizer(),
+        mesh,
+        embedding_optimizer=zoo.embedding_optimizer(),
+    )
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        return (
+            {
+                "dense": rng.rand(batch, zoo.NUM_DENSE).astype(np.float32),
+                "cat": rng.randint(0, vocab, size=(batch, zoo.NUM_CAT)).astype(
+                    np.int32
+                ),
+            },
+            rng.randint(0, 2, size=batch).astype(np.int32),
+            np.ones((batch,), np.float32),
+        )
+
+    first = make_batch()
+    trainer.ensure_initialized(first[0])
+    window = trainer.stage_window([make_batch() for _ in range(steps)])
+    np.asarray(trainer.train_window(window))
+    np.asarray(trainer.train_window(window))
+    with jax.profiler.trace(logdir):
+        np.asarray(trainer.train_window(window))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("workload", choices=["resnet50", "deepfm"])
+    parser.add_argument("--batch", type=int, default=0)
+    parser.add_argument("--logdir", default="")
+    parser.add_argument("--norm_f32", action="store_true")
+    args = parser.parse_args()
+    logdir = args.logdir or tempfile.mkdtemp(prefix=f"trace_{args.workload}_")
+    if args.workload == "resnet50":
+        run_resnet(args.batch or 512, logdir, norm_bf16=not args.norm_f32)
+    else:
+        run_deepfm(args.batch or 8192, logdir)
+    print("trace dir:", logdir)
+    summarize_xplane(logdir)
+
+
+if __name__ == "__main__":
+    main()
