@@ -125,6 +125,14 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "its training steps in [START, END) under "
         "<tensorboard_log_dir>/profile (TensorBoard Profile plugin)",
     )
+    parser.add_argument(
+        "--mesh_model_axis", type=pos_int, default=1,
+        help="Size of the mesh's `model` axis in cluster strategies "
+        "(total devices = data x model). >1 shards embedding tables over "
+        "it (PS mode) and enables sequence/context parallelism — zoo "
+        "models whose custom_model() accepts `mesh` (e.g. "
+        "transformer.transformer_lm) run ring attention over this axis",
+    )
     parser.add_argument("--task_timeout_s", type=non_neg_int, default=0)
     parser.add_argument(
         "--use_bf16", type=str2bool, nargs="?", const=True, default=True,

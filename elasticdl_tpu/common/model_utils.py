@@ -33,8 +33,23 @@ class ModelSpec:
     embedding_optimizer: Optional[Callable] = None
     model_params: dict = field(default_factory=dict)
 
-    def build_model(self):
-        return self.custom_model(**self.model_params)
+    def build_model(self, mesh=None):
+        """`mesh` is forwarded only to mesh-aware models (custom_model
+        declaring a `mesh` parameter — e.g. the transformer's ring
+        attention needs the mesh for its context axis)."""
+        import inspect
+
+        params = dict(self.model_params)
+        if mesh is not None and "mesh" not in params:
+            try:
+                accepts_mesh = (
+                    "mesh" in inspect.signature(self.custom_model).parameters
+                )
+            except (TypeError, ValueError):
+                accepts_mesh = False
+            if accepts_mesh:
+                params["mesh"] = mesh
+        return self.custom_model(**params)
 
 
 def load_module(model_zoo: str, model_def: str):

@@ -90,12 +90,17 @@ def _build_collective_worker(
     from elasticdl_tpu.worker.collective_worker import CollectiveWorker
 
     world = join_world(client)
-    mesh = build_mesh(MeshConfig())  # all devices of the joined world
+    # All devices of the joined world, shaped (data, model): the model
+    # axis carries sharded embedding tables and — for mesh-aware zoo
+    # models — ring-attention context parallelism.
+    mesh = build_mesh(
+        MeshConfig(model=getattr(args, "mesh_model_axis", 1))
+    )
     if args.distribution_strategy == "ParameterServerStrategy":
         from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
 
         trainer = ShardedEmbeddingTrainer(
-            model=model_spec.build_model(),
+            model=model_spec.build_model(mesh=mesh),
             loss_fn=model_spec.loss,
             optimizer=model_spec.optimizer(),
             mesh=mesh,
@@ -107,7 +112,7 @@ def _build_collective_worker(
         )
     else:
         trainer = DataParallelTrainer(
-            model=model_spec.build_model(),
+            model=model_spec.build_model(mesh=mesh),
             loss_fn=model_spec.loss,
             optimizer=model_spec.optimizer(),
             mesh=mesh,

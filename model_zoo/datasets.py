@@ -223,3 +223,29 @@ def synthetic_census_reader(n: int = 4096, seed: int = 0,
                 yield records[i]
 
     return _CensusReader()
+
+
+def synthetic_lm_reader(
+    n: int = 2048,
+    seq_len: int = 128,
+    vocab: int = 256,
+    seed: int = 0,
+    shard_name: str = "lm-synth",
+):
+    """Language-modeling-shaped learnable synthetic data: token sequences
+    from a deterministic affine bigram chain (next = 3*tok + 7 mod vocab)
+    with 10% uniform noise — a next-token structure a small transformer
+    learns quickly, so training loss genuinely decreases.  A record is
+    (tokens [seq_len] int32, next_tokens [seq_len] int32)."""
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, vocab, size=n)
+    noise = rng.random(size=(n, seq_len)) < 0.1
+    noise_tok = rng.integers(0, vocab, size=(n, seq_len))
+    seqs = np.empty((n, seq_len + 1), np.int32)
+    seqs[:, 0] = starts
+    for t in range(seq_len):
+        nxt = (3 * seqs[:, t] + 7) % vocab
+        seqs[:, t + 1] = np.where(noise[:, t], noise_tok[:, t], nxt)
+    return NumpyDataReader(
+        seqs[:, :-1].copy(), seqs[:, 1:].copy(), shard_name=shard_name
+    )

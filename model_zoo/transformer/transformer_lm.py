@@ -1,0 +1,194 @@
+"""Transformer causal LM — the long-context / context-parallel config.
+
+Net-new scope beyond the reference (SURVEY.md §5: the reference predates
+long-context training and has none; this framework treats it as
+first-class).  A pre-LN decoder-only transformer whose attention runs:
+
+- single-device: `blockwise_attention` (flash numerics; KV processed in
+  chunks so score slabs are [T, kv_chunk], never the full [T, T]), or
+- context-parallel: `ring_attention` under shard_map — the sequence dim
+  shards over the mesh's `model` axis, K/V blocks rotate over ICI
+  (parallel/ring_attention.py) — when built with `custom_model(mesh=...)`
+  and the mesh's model axis is > 1.
+
+Everything else is ordinary flax the DataParallelTrainer already handles:
+params replicated (f32), bf16 compute, batch sharded over `data`, XLA
+psums the grads.  Model-zoo contract functions at the bottom; synthetic
+`synthetic://lm?n=N&len=T&vocab=V` data (model_zoo/datasets.py) makes
+next-token loss genuinely learnable in tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from elasticdl_tpu.parallel.ring_attention import (
+    _shard_map,
+    blockwise_attention,
+    ring_attention,
+)
+from model_zoo import datasets
+
+VOCAB = 256
+SEQ_LEN = 128
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None  # jax.sharding.Mesh -> ring attention over `model`
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, e = x.shape
+        head_dim = e // self.num_heads
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, head_dim), dtype=self.dtype, name="qkv"
+        )(x)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # [B, T, H, D] each
+        cp = (
+            self.mesh is not None
+            and self.mesh.shape.get(MODEL_AXIS, 1) > 1
+        )
+        if cp:
+            spec = jax.sharding.PartitionSpec(
+                DATA_AXIS, MODEL_AXIS, None, None
+            )
+            attend = _shard_map()(
+                partial(ring_attention, axis_name=MODEL_AXIS, causal=True),
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+            )
+        else:
+            attend = partial(blockwise_attention, causal=True)
+        out = attend(q, k, v)  # [B, T, H, D]
+        out = out.reshape(b, t, e)
+        return nn.Dense(e, dtype=self.dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        e = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.dtype, self.mesh, name="attn"
+        )(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(e * self.mlp_ratio, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(e, dtype=self.dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    vocab: int = VOCAB
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 4096
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, t = tokens.shape
+        tok = nn.Embed(self.vocab, self.d_model, dtype=self.dtype)(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype)(
+            jnp.arange(t)[None, :]
+        )
+        x = tok + pos
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads, dtype=self.dtype, mesh=self.mesh,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        # Logits in f32: the loss softmax wants full precision.
+        return nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(x)
+
+
+def custom_model(
+    vocab: int = VOCAB,
+    d_model: int = 128,
+    num_heads: int = 4,
+    num_layers: int = 2,
+    max_len: int = 4096,
+    use_bf16: bool = True,
+    mesh: Optional[Any] = None,
+):
+    """`mesh=None` -> single-device blockwise attention; pass the
+    trainer's mesh (model axis > 1) for ring-attention context
+    parallelism.  The model-axis size must then divide the sequence
+    length (each device holds T / model_axis contiguous positions)."""
+    return TransformerLM(
+        vocab=vocab,
+        d_model=d_model,
+        num_heads=num_heads,
+        num_layers=num_layers,
+        max_len=max_len,
+        dtype=jnp.bfloat16 if use_bf16 else jnp.float32,
+        mesh=mesh,
+    )
+
+
+def loss(labels, predictions):
+    """Mean next-token cross-entropy; labels [B, T], logits [B, T, V]."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions.astype(jnp.float32), labels.astype(jnp.int32)
+    ).mean()
+
+
+def optimizer(lr: float = 3e-3):
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def dataset_fn(dataset, mode, metadata):
+    def parse(record):
+        tokens, next_tokens = record
+        return np.asarray(tokens, np.int32), np.asarray(
+            next_tokens, np.int32
+        )
+
+    dataset = dataset.map(parse)
+    if mode == "training":
+        dataset = dataset.shuffle(1024, seed=0)
+    return dataset
+
+
+def eval_metrics_fn():
+    def perplexity(outputs, labels):
+        ce = float(loss(jnp.asarray(labels), jnp.asarray(outputs)))
+        return float(np.exp(min(ce, 20.0)))
+
+    return {
+        "perplexity": perplexity,
+        "accuracy": lambda outputs, labels: float(
+            np.mean(np.argmax(outputs, axis=-1) == labels)
+        ),
+    }
+
+
+def custom_data_reader(data_path: str, **kwargs):
+    name, params = datasets.parse_synthetic_path(data_path)
+    if name != "lm":
+        return None
+    return datasets.synthetic_lm_reader(
+        n=params.get("n", 2048),
+        seq_len=params.get("len", SEQ_LEN),
+        vocab=params.get("vocab", VOCAB),
+        seed=params.get("seed", 0),
+    )

@@ -1,0 +1,122 @@
+"""Transformer LM (long-context config) tests: single-device and
+context-parallel (ring attention over the model axis) training, plus
+parity between the two."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.parallel import MeshConfig, build_mesh
+from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+from model_zoo import datasets
+from model_zoo.transformer import transformer_lm as zoo
+
+
+def _batches(n=64, mb=16, seq_len=64, seed=0):
+    from elasticdl_tpu.data.dataset import Dataset, _stack
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+    reader = datasets.synthetic_lm_reader(
+        n=n, seq_len=seq_len, vocab=zoo.VOCAB, seed=seed
+    )
+    task = pb.Task(task_id=1, shard_name="s", start=0, end=n)
+    records = list(
+        zoo.dataset_fn(
+            Dataset.from_generator(lambda: reader.read_records(task)),
+            "training",
+            None,
+        )
+    )
+    for i in range(0, n, mb):
+        yield _stack(records[i : i + mb])
+
+
+def test_lm_trains_single_device():
+    mesh = build_mesh(MeshConfig(data=1, model=1),
+                      devices=jax.devices()[:1])
+    trainer = DataParallelTrainer(
+        zoo.custom_model(d_model=64, num_layers=2),
+        zoo.loss, zoo.optimizer(), mesh,
+    )
+    losses = []
+    for epoch in range(4):
+        for tokens, labels in _batches(seed=epoch % 2):
+            losses.append(float(trainer.train_step(tokens, labels)))
+    assert losses[-1] < losses[0] * 0.7, (
+        f"no learning: {losses[:2]} -> {losses[-2:]}"
+    )
+
+
+def test_lm_trains_context_parallel():
+    """dp=2 x cp=4: batch over `data`, sequence ring over `model`."""
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    trainer = DataParallelTrainer(
+        zoo.custom_model(d_model=64, num_layers=2, mesh=mesh),
+        zoo.loss, zoo.optimizer(), mesh,
+    )
+    losses = []
+    for epoch in range(4):
+        for tokens, labels in _batches(seed=epoch % 2):
+            losses.append(float(trainer.train_step(tokens, labels)))
+    assert losses[-1] < losses[0] * 0.7, (
+        f"no learning: {losses[:2]} -> {losses[-2:]}"
+    )
+
+
+def test_cp_and_single_device_agree():
+    """Same init, same batch: the context-parallel forward must match the
+    single-device forward (ring attention is exact, not approximate)."""
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    tokens, _ = next(_batches(n=8, mb=8, seq_len=64))
+    tokens = jnp.asarray(tokens)
+
+    single = zoo.custom_model(d_model=64, use_bf16=False)
+    ringed = zoo.custom_model(d_model=64, use_bf16=False, mesh=mesh)
+    variables = single.init(jax.random.PRNGKey(0), tokens)
+    out_single = single.apply(variables, tokens)
+    out_ring = ringed.apply(variables, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_single), np.asarray(out_ring), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_lm_context_parallel_cluster_e2e(tmp_path, monkeypatch):
+    """Full cluster path: 2 worker processes x 2 CPU devices = a 4-device
+    world, --mesh_model_axis=2 -> mesh 2x2 (data x model).  The sequence
+    ring spans PROCESS boundaries; the job must train every record and
+    write a checkpoint."""
+    import os
+
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.constants import Mode
+    from elasticdl_tpu.master.job_runner import run_allreduce_job
+
+    monkeypatch.setenv("ELASTICDL_FORCE_PLATFORM", "cpu")
+    monkeypatch.setenv(
+        "ELASTICDL_WORKER_ENV",
+        ";".join(
+            f"{k}={v}"
+            for k, v in {
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "ELASTICDL_FORCE_PLATFORM": "cpu",
+                "JAX_PLATFORMS": "cpu",
+            }.items()
+        ),
+    )
+    args = parse_master_args([
+        "--model_zoo=model_zoo",
+        "--model_def=transformer.transformer_lm",
+        "--model_params=d_model=32,num_layers=1,num_heads=2",
+        "--training_data=synthetic://lm?n=64&len=32",
+        "--records_per_task=32",
+        "--minibatch_size=8",
+        "--num_workers=2",
+        "--mesh_model_axis=2",
+        "--distribution_strategy=AllreduceStrategy",
+        f"--checkpoint_dir={tmp_path / 'ckpt'}",
+        "--checkpoint_steps=4",
+        "--num_epochs=1",
+    ])
+    rc = run_allreduce_job(args, Mode.TRAINING)
+    assert rc == 0
+    assert any(p.startswith("step_") for p in os.listdir(tmp_path / "ckpt"))
