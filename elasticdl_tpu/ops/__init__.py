@@ -1,0 +1,1 @@
+from elasticdl_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,331 @@
+"""Pallas TPU flash-attention kernel (forward + backward).
+
+The hot op of the long-context path on a single chip (the cross-chip
+ring in parallel/ring_attention.py currently uses its own XLA block
+math — fusing this kernel into the ring steps would require exposing
+the m/l accumulators and is future work).  A hand-scheduled Pallas
+kernel instead of the XLA-fused blockwise einsum
+because attention's online-softmax recurrence is exactly the pattern XLA
+can't restructure itself: the [T, T] score slab must never exist, scores
+must stay resident in VMEM between the two matmuls, and the causal
+upper-triangle must be SKIPPED (not computed-then-masked).  Standard
+flash-attention scheme (grid over (batch, heads, q-blocks), K/V streamed
+block-wise from VMEM, f32 running max/denominator carried in registers),
+with the standard two-kernel backward (dq pass over q-blocks, dk/dv pass
+over k-blocks, recomputing probabilities from the saved logsumexp).
+
+`flash_attention` is a drop-in for `blockwise_attention`'s self-attention
+case: [B, T, H, D] in, [B, T, H, D] out, differentiable via custom_vjp.
+Off-TPU (tests, CPU meshes) the kernels run in Pallas interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+# Measured on the v5e (B4 T2048 H8 D128, causal): fwd 256->4.18ms,
+# 512->3.88ms, 1024/512->4.01ms; XLA blockwise 5.88ms.  512 wins.
+DEFAULT_BLOCK = 512
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pos(block: int, index, dim: int):
+    """Global positions of a block's rows as a 2-D iota (TPU needs >=2D)."""
+    return index * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, 1) if dim == 0 else (1, block), dim
+    )
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
+    t_k = k_ref.shape[2]
+    n_k = t_k // block_k
+    if causal:
+        # K blocks strictly above the diagonal are never touched.
+        n_k = jnp.minimum(n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
+    q_pos = _pos(block_q, qi, 0)  # [block_q, 1]
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            k_pos = _pos(block_k, j, 1)  # [1, block_k]
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * correction + pv
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)  # [block_q, 1]
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    grid = (b, h, t // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ----------------------------------------------------------------------
+# backward: dq pass (grid over q-blocks), dk/dv pass (grid over k-blocks)
+# ----------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # [block_q, 1]
+    delta = delta_ref[0, 0]
+    t_k = k_ref.shape[2]
+    n_k = t_k // block_k
+    if causal:
+        n_k = jnp.minimum(n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
+    q_pos = _pos(block_q, qi, 0)
+
+    def body(j, dq):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * scale, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            k_pos = _pos(block_k, j, 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        p = jnp.exp(s - lse)  # [block_q, block_k]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, n_k, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    )
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k):
+    kj = pl.program_id(2)
+    k_blk = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    t_q = q_ref.shape[2]
+    n_q = t_q // block_q
+    # Causal: q-blocks strictly before this k-block see none of it.
+    start = (kj * block_k) // block_q if causal else 0
+    k_pos = _pos(block_k, kj, 0)  # [block_k, 1] (rows = k here)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0][None, :]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0][None, :]
+        # Transposed layout: s_t [block_k, block_q].
+        s_t = jax.lax.dot_general(
+            k_blk, q * scale, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = _pos(block_q, i, 1)  # [1, block_q]
+            s_t = jnp.where(k_pos > q_pos, NEG_INF, s_t)
+        p_t = jnp.exp(s_t - lse)  # [block_k, block_q]
+        dv_new = dv + jax.lax.dot_general(
+            p_t, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_t = jax.lax.dot_general(
+            v_blk, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    d = k_blk.shape[1]
+    dk, dv = jax.lax.fori_loop(
+        start, n_q, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)),
+    )
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, h, t, d = q.shape
+    do = g.astype(jnp.float32)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term.
+    delta = jnp.sum(
+        do * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [B, H, T, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(b, h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(b, h, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, t, d), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, t, d), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, t, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, t, 1), lambda b, h, j: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# public entry
+# ----------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def supports(t: int, d: int, block: int = DEFAULT_BLOCK) -> bool:
+    """Whether the kernel handles this (seq_len, head_dim) shape."""
+    block = min(block, t)
+    return t % block == 0 and t % 8 == 0 and d % 8 == 0
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    interpret: Optional[bool] = None,
+):
+    """Self-attention [B, T, H, D] -> [B, T, H, D], Pallas kernels.
+
+    T must be a multiple of block_q/block_k (`supports()` checks); use
+    parallel.ring_attention.blockwise_attention for irregular shapes.
+    """
+    b, t, h, d = q.shape
+    # Short sequences: shrink blocks to the sequence (T itself is a valid
+    # single block when sublane-aligned).
+    block_q, block_k = min(block_q, t), min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(
+            f"seq len {t} must be a multiple of block sizes "
+            f"({block_q}, {block_k})"
+        )
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    interpret = _use_interpret() if interpret is None else interpret
+    # Kernels run in [B, H, T, D].
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash(qt, kt, vt, scale, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -178,9 +178,12 @@ def ring_attention(
 
         if causal:
             # A KV block from a strictly-later shard (src > my_index) is
-            # fully masked — skip its matmuls entirely.  Roughly half the
-            # ring steps on each device are skips, reclaiming the ~(N-1)/2N
-            # of attention FLOPs the mask would otherwise discard.
+            # fully masked — skip its matmuls.  This reclaims FLOPs/energy,
+            # NOT wall-clock: the ring is lockstep (each step ends at the
+            # ppermute), and the device holding the last shard attends at
+            # every step, so the critical path still runs N full blocks.
+            # Balancing it (zigzag/striped sequence-to-shard layout) is
+            # the known fix and deliberately out of scope here.
             m, l, acc = jax.lax.cond(
                 src > my_index, lambda ops: ops, attend, (m, l, acc)
             )
@@ -204,6 +207,21 @@ def ring_attention(
     return _finalize(m, l, acc, q.dtype)
 
 
+def make_ring_attention(mesh, *, axis: str = MODEL_AXIS,
+                        causal: bool = False):
+    """Build the shard_mapped ring-attention callable for `mesh`: batch
+    sharded over `data`, sequence over `axis`.  The ONE place the
+    sharding specs live — both ring_self_attention and mesh-aware models
+    (model_zoo/transformer) call this."""
+    spec = P(DATA_AXIS, axis, None, None)
+    return _shard_map()(
+        partial(ring_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+
 def ring_self_attention(
     mesh,
     q: jax.Array,
@@ -215,19 +233,11 @@ def ring_self_attention(
 ):
     """Host-level entry: global [B, T, H, D] arrays in, attention out,
     computed ring-wise with batch sharded over `data` and sequence over
-    `axis`.  (Inside a jitted step prefer calling `ring_attention` from
-    your own shard_map so it fuses with the rest of the program.)"""
-    shard_map = _shard_map()
-
+    `axis`.  (Inside a jitted step prefer calling `make_ring_attention`'s
+    result from your own code so it fuses with the rest of the program.)"""
     k = q if k is None else k
     v = q if v is None else v
-    spec = P(DATA_AXIS, axis, None, None)
-    fn = shard_map(
-        partial(ring_attention, axis_name=axis, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-    )
-    sharding = NamedSharding(mesh, spec)
+    fn = make_ring_attention(mesh, axis=axis, causal=causal)
+    sharding = NamedSharding(mesh, P(DATA_AXIS, axis, None, None))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return fn(q, k, v)

@@ -29,11 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from elasticdl_tpu.parallel.mesh import MODEL_AXIS
 from elasticdl_tpu.parallel.ring_attention import (
-    _shard_map,
     blockwise_attention,
-    ring_attention,
+    make_ring_attention,
 )
 from model_zoo import datasets
 
@@ -45,6 +44,22 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
     mesh: Any = None  # jax.sharding.Mesh -> ring attention over `model`
+    # "auto": the Pallas flash kernel on TPU when the shape qualifies,
+    # XLA blockwise otherwise.  "pallas"/"xla" force one implementation.
+    attn_impl: str = "auto"
+
+    def _single_device_attend(self, t: int, head_dim: int):
+        from elasticdl_tpu.ops import flash_attention
+        from elasticdl_tpu.ops.flash_attention import supports
+
+        use_pallas = self.attn_impl == "pallas" or (
+            self.attn_impl == "auto"
+            and jax.default_backend() == "tpu"
+            and supports(t, head_dim)
+        )
+        if use_pallas:
+            return partial(flash_attention, causal=True)
+        return partial(blockwise_attention, causal=True)
 
     @nn.compact
     def __call__(self, x):
@@ -59,17 +74,15 @@ class CausalSelfAttention(nn.Module):
             and self.mesh.shape.get(MODEL_AXIS, 1) > 1
         )
         if cp:
-            spec = jax.sharding.PartitionSpec(
-                DATA_AXIS, MODEL_AXIS, None, None
-            )
-            attend = _shard_map()(
-                partial(ring_attention, axis_name=MODEL_AXIS, causal=True),
-                mesh=self.mesh,
-                in_specs=(spec, spec, spec),
-                out_specs=spec,
-            )
+            if self.attn_impl == "pallas":
+                raise ValueError(
+                    "attn_impl='pallas' is single-device only; the "
+                    "context-parallel (mesh model axis > 1) path runs "
+                    "ring attention's XLA block engine"
+                )
+            attend = make_ring_attention(self.mesh, causal=True)
         else:
-            attend = partial(blockwise_attention, causal=True)
+            attend = self._single_device_attend(t, head_dim)
         out = attend(q, k, v)  # [B, T, H, D]
         out = out.reshape(b, t, e)
         return nn.Dense(e, dtype=self.dtype, name="proj")(out)
@@ -80,13 +93,15 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     mesh: Any = None
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
         e = x.shape[-1]
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + CausalSelfAttention(
-            self.num_heads, self.dtype, self.mesh, name="attn"
+            self.num_heads, self.dtype, self.mesh, self.attn_impl,
+            name="attn",
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(e * self.mlp_ratio, dtype=self.dtype)(h)
@@ -102,6 +117,7 @@ class TransformerLM(nn.Module):
     max_len: int = 4096
     dtype: Any = jnp.bfloat16
     mesh: Any = None
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -114,7 +130,7 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = Block(
                 self.num_heads, dtype=self.dtype, mesh=self.mesh,
-                name=f"block_{i}",
+                attn_impl=self.attn_impl, name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # Logits in f32: the loss softmax wants full precision.
@@ -129,6 +145,7 @@ def custom_model(
     max_len: int = 4096,
     use_bf16: bool = True,
     mesh: Optional[Any] = None,
+    attn_impl: str = "auto",
 ):
     """`mesh=None` -> single-device blockwise attention; pass the
     trainer's mesh (model axis > 1) for ring-attention context
@@ -142,6 +159,7 @@ def custom_model(
         max_len=max_len,
         dtype=jnp.bfloat16 if use_bf16 else jnp.float32,
         mesh=mesh,
+        attn_impl=attn_impl,
     )
 
 

@@ -1,0 +1,81 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU).
+
+Golden parity vs dense attention — forward and backward (the custom-VJP
+dq / dk/dv kernels) — full and causal, f32 and bf16.  The real-TPU
+lowering of the same kernels is exercised by the transformer bench
+(BASELINE.md) on hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.ops import flash_attention
+from elasticdl_tpu.ops.flash_attention import supports
+from tests.test_ring_attention import _qkv, dense_attention
+
+BLOCK = dict(block_q=16, block_k=16)  # tiny blocks: interpret mode is slow
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_dense(causal):
+    q, k, v = _qkv(b=1, t=64, h=2, d=16, seed=0)
+    out = flash_attention(q, k, v, causal=causal, **BLOCK)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_dense(causal):
+    q, k, v = _qkv(b=1, t=32, h=2, d=8, seed=4)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, **BLOCK) ** 2
+        )
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_bf16_forward_close_to_f32_dense():
+    q, k, v = _qkv(b=1, t=32, h=2, d=16, seed=2, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, **BLOCK)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.05, rtol=0.05
+    )
+
+
+def test_supports_and_shape_validation():
+    assert supports(256, 64)
+    assert not supports(100, 64)  # not a block multiple
+    q, k, v = _qkv(b=1, t=24, h=2, d=8)
+    with pytest.raises(ValueError, match="multiple of block sizes"):
+        flash_attention(q, k, v, **BLOCK)
+
+
+def test_under_jit_and_vmapless_batch():
+    """The kernel composes with jit (the trainers always jit the step)."""
+    q, k, v = _qkv(b=2, t=32, h=2, d=8, seed=9)
+    f = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, **BLOCK)
+    )
+    np.testing.assert_allclose(
+        np.asarray(f(q, k, v)),
+        np.asarray(dense_attention(q, k, v, causal=True)),
+        atol=2e-5,
+    )

@@ -120,3 +120,20 @@ def test_lm_context_parallel_cluster_e2e(tmp_path, monkeypatch):
     rc = run_allreduce_job(args, Mode.TRAINING)
     assert rc == 0
     assert any(p.startswith("step_") for p in os.listdir(tmp_path / "ckpt"))
+
+
+def test_pallas_attn_impl_matches_xla():
+    """attn_impl='pallas' (interpret mode on CPU) must match the XLA
+    blockwise implementation through the full model."""
+    tokens, _ = next(_batches(n=4, mb=4, seq_len=32))
+    tokens = jnp.asarray(tokens)
+    xla_model = zoo.custom_model(d_model=32, num_heads=2, num_layers=1,
+                                 use_bf16=False, attn_impl="xla")
+    pls_model = zoo.custom_model(d_model=32, num_heads=2, num_layers=1,
+                                 use_bf16=False, attn_impl="pallas")
+    variables = xla_model.init(jax.random.PRNGKey(0), tokens)
+    out_x = xla_model.apply(variables, tokens)
+    out_p = pls_model.apply(variables, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_x), np.asarray(out_p), atol=2e-4, rtol=2e-4
+    )
