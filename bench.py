@@ -51,6 +51,9 @@ SELF_BASELINE = {
     # First measured in round 2 (no earlier number exists); vs_baseline
     # therefore tracks drift against the round-2 recording in BASELINE.md.
     "resnet50_images_per_sec_per_chip": 1_524.0,
+    # Net-new scope (no reference counterpart, BASELINE.md long-context
+    # section): Pallas flash-attention transformer LM, recorded round 2.
+    "transformer_lm_tokens_per_sec_per_chip": 241_046.0,
 }
 
 
@@ -175,6 +178,66 @@ def bench_resnet50(
     return median / n_chips, spread
 
 
+def bench_transformer(
+    batch_size: int = 8,
+    seq_len: int = 2048,
+    steps_per_window: int = 20,
+    repeats: int = 5,
+):
+    """Long-context config (net-new vs the reference): 4-layer d512 causal
+    LM, T=2048, Pallas flash-attention kernel (ops/flash_attention.py)."""
+    import jax
+
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+    from model_zoo.transformer import transformer_lm as zoo
+
+    mesh = build_mesh(MeshConfig())
+    trainer = DataParallelTrainer(
+        zoo.custom_model(
+            vocab=32768, d_model=512, num_heads=8, num_layers=4,
+            max_len=seq_len,
+        ),
+        zoo.loss,
+        zoo.optimizer(),
+        mesh,
+    )
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        return (
+            rng.randint(0, 32768, size=(batch_size, seq_len)).astype(
+                np.int32
+            ),
+            rng.randint(0, 32768, size=(batch_size, seq_len)).astype(
+                np.int32
+            ),
+            np.ones((batch_size,), np.float32),
+        )
+
+    window = trainer.stage_window(
+        [make_batch() for _ in range(steps_per_window)]
+    )
+
+    def run_window(i: int) -> float:
+        start = time.perf_counter()
+        losses = trainer.train_window(window)
+        host_losses = np.asarray(losses)  # completion fence (see deepfm)
+        assert np.isfinite(host_losses).all()
+        return time.perf_counter() - start
+
+    run_window(0)
+    run_window(1)
+    times = [run_window(i) for i in range(repeats)]
+    rates = sorted(
+        batch_size * seq_len * steps_per_window / t for t in times
+    )
+    median = rates[len(rates) // 2]
+    spread = (rates[-1] - rates[0]) / median
+    n_chips = max(1, len(jax.devices()))
+    return median / n_chips, spread
+
+
 def _emit(metric: str, value: float, unit: str, spread: float):
     print(
         json.dumps(
@@ -191,6 +254,13 @@ def _emit(metric: str, value: float, unit: str, spread: float):
 
 
 def main():
+    tokens_per_sec, t_spread = bench_transformer()
+    _emit(
+        "transformer_lm_tokens_per_sec_per_chip",
+        tokens_per_sec,
+        "tokens/sec/chip",
+        t_spread,
+    )
     images_per_sec, r_spread = bench_resnet50()
     _emit(
         "resnet50_images_per_sec_per_chip",

@@ -138,6 +138,48 @@ def blockwise_attention(
     return _finalize(m, l, acc, q.dtype)
 
 
+def zigzag_order(t: int, n_shards: int):
+    """Global-position permutation for `layout="zigzag"`: applying it to
+    the sequence dim and then sharding contiguously gives shard i the
+    position chunks (i, 2N-1-i) — every shard then carries one early and
+    one late chunk, so causal ring work is BALANCED across shards
+    instead of piling onto the last one.  `t % (2 * n_shards) == 0`.
+    Invert with `inverse_order`."""
+    import numpy as np
+
+    if t % (2 * n_shards):
+        raise ValueError(f"t={t} must divide into 2*{n_shards} chunks")
+    h = t // (2 * n_shards)
+    idx = []
+    for i in range(n_shards):
+        idx.extend(range(i * h, (i + 1) * h))
+        j = 2 * n_shards - 1 - i
+        idx.extend(range(j * h, (j + 1) * h))
+    return np.asarray(idx)
+
+
+def inverse_order(order):
+    import numpy as np
+
+    inv = np.empty_like(order)
+    inv[order] = np.arange(len(order))
+    return inv
+
+
+def _shard_positions(index, t_local, axis_size, layout):
+    """Global positions of shard `index`'s local rows under `layout`."""
+    if layout == "contiguous":
+        return index * t_local + jnp.arange(t_local)
+    half = t_local // 2
+    late = 2 * axis_size - 1 - index
+    return jnp.concatenate(
+        [
+            index * half + jnp.arange(half),
+            late * half + jnp.arange(half),
+        ]
+    )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -146,6 +188,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    layout: str = "contiguous",
 ):
     """Collective attention over sequence shards; call under shard_map.
 
@@ -154,13 +197,27 @@ def ring_attention(
     `axis_size` ring steps attends Q against one rotating KV block, then
     ppermutes KV to the next device — the transfer and the next block's
     compute overlap under XLA's scheduler.
+
+    `layout` declares how global positions map to shards:
+
+    - "contiguous": shard i holds positions [i*T_local, (i+1)*T_local).
+      Causal fully-masked blocks are lax.cond-skipped — reclaiming FLOPs
+      but NOT wall-clock (the ring is lockstep; the last shard attends
+      at every step, so the critical path still runs N full blocks).
+    - "zigzag": shard i holds chunks (i, 2N-1-i) of 2N chunks (pre-
+      permute the global sequence with `zigzag_order`).  Every shard
+      does the same ~half-masked work at every causal step, cutting the
+      causal critical path toward N/2 block-attends — the standard
+      balanced causal ring.
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    q_pos = my_index * tq + jnp.arange(tq)
+    q_pos = _shard_positions(my_index, tq, axis_size, layout)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
@@ -168,7 +225,7 @@ def ring_attention(
         m, l, acc, k_blk, v_blk = carry
         # KV block currently held arrived from `my_index - step`.
         src = (my_index - step) % axis_size
-        k_pos = src * tk + jnp.arange(tk)
+        k_pos = _shard_positions(src, tk, axis_size, layout)
 
         def attend(operands):
             m, l, acc = operands
@@ -176,18 +233,17 @@ def ring_attention(
                 q, k_blk, v_blk, scale, q_pos, k_pos, causal, m, l, acc
             )
 
-        if causal:
+        if causal and layout == "contiguous":
             # A KV block from a strictly-later shard (src > my_index) is
-            # fully masked — skip its matmuls.  This reclaims FLOPs/energy,
-            # NOT wall-clock: the ring is lockstep (each step ends at the
-            # ppermute), and the device holding the last shard attends at
-            # every step, so the critical path still runs N full blocks.
-            # Balancing it (zigzag/striped sequence-to-shard layout) is
-            # the known fix and deliberately out of scope here.
+            # fully masked — skip its matmuls (FLOPs, not wall-clock;
+            # see the layout note above — "zigzag" is the wall-clock fix).
             m, l, acc = jax.lax.cond(
                 src > my_index, lambda ops: ops, attend, (m, l, acc)
             )
         else:
+            # Zigzag blocks are never fully masked (every shard holds an
+            # early chunk): always attend — that uniformity IS the
+            # balance.
             m, l, acc = attend((m, l, acc))
         # Rotate for the next step (skipped result on the last step).
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
@@ -208,14 +264,18 @@ def ring_attention(
 
 
 def make_ring_attention(mesh, *, axis: str = MODEL_AXIS,
-                        causal: bool = False):
+                        causal: bool = False, layout: str = "contiguous"):
     """Build the shard_mapped ring-attention callable for `mesh`: batch
     sharded over `data`, sequence over `axis`.  The ONE place the
     sharding specs live — both ring_self_attention and mesh-aware models
-    (model_zoo/transformer) call this."""
+    (model_zoo/transformer) call this.  With `layout="zigzag"` the
+    caller is responsible for feeding sequences permuted by
+    `zigzag_order` (and un-permuting outputs with `inverse_order`)."""
     spec = P(DATA_AXIS, axis, None, None)
     return _shard_map()(
-        partial(ring_attention, axis_name=axis, causal=causal),
+        partial(
+            ring_attention, axis_name=axis, causal=causal, layout=layout
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -230,14 +290,30 @@ def ring_self_attention(
     *,
     axis: str = MODEL_AXIS,
     causal: bool = False,
+    layout: str = "contiguous",
 ):
     """Host-level entry: global [B, T, H, D] arrays in, attention out,
     computed ring-wise with batch sharded over `data` and sequence over
     `axis`.  (Inside a jitted step prefer calling `make_ring_attention`'s
-    result from your own code so it fuses with the rest of the program.)"""
+    result from your own code so it fuses with the rest of the program.)
+
+    `layout="zigzag"` handles the permutation here: inputs/outputs stay
+    in natural sequence order, the balanced layout is internal."""
     k = q if k is None else k
     v = q if v is None else v
-    fn = make_ring_attention(mesh, axis=axis, causal=causal)
+    fn = make_ring_attention(mesh, axis=axis, causal=causal, layout=layout)
     sharding = NamedSharding(mesh, P(DATA_AXIS, axis, None, None))
+    if layout == "zigzag":
+        if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
+            raise ValueError(
+                "layout='zigzag' requires equal q/k/v sequence lengths "
+                f"(got q={q.shape[1]}, k={k.shape[1]}, v={v.shape[1]}); "
+                "the balanced layout is a self-attention arrangement"
+            )
+        order = zigzag_order(q.shape[1], mesh.shape[axis])
+        inv = inverse_order(order)
+        q, k, v = (x[:, order] for x in (q, k, v))
+        q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+        return fn(q, k, v)[:, inv]
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return fn(q, k, v)

@@ -52,6 +52,11 @@ class CausalSelfAttention(nn.Module):
         from elasticdl_tpu.ops import flash_attention
         from elasticdl_tpu.ops.flash_attention import supports
 
+        if self.attn_impl not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"attn_impl must be 'auto', 'pallas' or 'xla', "
+                f"got {self.attn_impl!r}"
+            )
         use_pallas = self.attn_impl == "pallas" or (
             self.attn_impl == "auto"
             and jax.default_backend() == "tpu"

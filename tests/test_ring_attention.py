@@ -129,3 +129,62 @@ def test_blockwise_kv_chunking_matches_dense(causal):
     out = blockwise_attention(q, k, v, causal=causal, kv_chunk=16)
     ref = dense_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_zigzag_layout_matches_dense(causal):
+    """Balanced causal layout: shard i holds chunks (i, 2N-1-i); the
+    wrapper permutes in/out, so results must equal dense attention in
+    natural order."""
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, k, v = _qkv(b=2, t=64, seed=13)
+    out = ring_self_attention(mesh, q, k, v, causal=causal, layout="zigzag")
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_zigzag_order_roundtrip():
+    from elasticdl_tpu.parallel.ring_attention import (
+        inverse_order,
+        zigzag_order,
+    )
+
+    order = zigzag_order(32, 4)
+    inv = inverse_order(order)
+    np.testing.assert_array_equal(np.sort(order), np.arange(32))
+    np.testing.assert_array_equal(order[inv], np.arange(32))
+    # Shard 0 of 4 holds chunks 0 and 7 (of 8).
+    assert list(order[:4]) == [0, 1, 2, 3]
+    assert list(order[4:8]) == [28, 29, 30, 31]
+    with pytest.raises(ValueError, match="chunks"):
+        zigzag_order(30, 4)
+
+
+def test_zigzag_gradients_match_dense():
+    """Zigzag changes the differentiated graph (no cond skip, plus the
+    in/out permutation gathers) — backward must still match dense."""
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, k, v = _qkv(b=2, t=32, seed=17)
+
+    def zig_loss(q, k, v):
+        out = ring_self_attention(mesh, q, k, v, causal=True,
+                                  layout="zigzag")
+        return jnp.sum(out ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    g_zig = jax.grad(zig_loss, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_zig, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=5e-4
+        )
+
+
+def test_zigzag_rejects_cross_attention_lengths():
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, _, _ = _qkv(b=2, t=32, seed=1)
+    k, _, _ = _qkv(b=2, t=64, seed=2)
+    with pytest.raises(ValueError, match="equal q/k/v sequence lengths"):
+        ring_self_attention(mesh, q, k, k, causal=True, layout="zigzag")
