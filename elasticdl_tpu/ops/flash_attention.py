@@ -125,73 +125,90 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 # ----------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_q, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, block_q, block_k):
+    """Grid (B, H, n_q, n_k), k innermost: each step adds one KV block's
+    contribution to this q-block's gradient.  The f32 accumulator lives
+    in VMEM scratch across the inner grid steps (TPU grids are
+    sequential), and only the final [block_q, D] block is written out —
+    no full-[T, D] buffer ever sits in VMEM, so T scales past the
+    scoped-VMEM ceiling the fori-loop-over-full-KV formulation hit."""
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]  # [block_q, 1]
-    delta = delta_ref[0, 0]
-    t_k = k_ref.shape[2]
-    n_k = t_k // block_k
-    if causal:
-        n_k = jnp.minimum(n_k, ((qi + 1) * block_q + block_k - 1) // block_k)
-    q_pos = _pos(block_q, qi, 0)
+    kj = pl.program_id(3)
+    n_k = pl.num_programs(3)
 
-    def body(j, dq):
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    # Fully-masked (q-block entirely before k-block): skip the matmuls.
+    live = (qi + 1) * block_q > kj * block_k if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # [block_q, 1]
+        delta = delta_ref[0, 0]
+        k_blk = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q * scale, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         if causal:
-            k_pos = _pos(block_k, j, 1)
-            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+            mask = _pos(block_k, kj, 1) > _pos(block_q, qi, 0)
+            s = jnp.where(mask, NEG_INF, s)
         p = jnp.exp(s - lse)  # [block_q, block_k]
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
+        dq_acc[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq = jax.lax.fori_loop(
-        0, n_k, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    )
-    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(kj == n_k - 1)
+    def _emit():
+        dq_ref[0, 0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q, block_k):
+                dk_ref, dv_ref, dk_acc, dv_acc, *,
+                scale, causal, block_q, block_k):
+    """Grid (B, H, n_k, n_q), q innermost; mirror of _dq_kernel with the
+    roles swapped — see its docstring for the accumulation scheme."""
     kj = pl.program_id(2)
-    k_blk = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
-    v_blk = v_ref[0, 0].astype(jnp.float32)
-    t_q = q_ref.shape[2]
-    n_q = t_q // block_q
-    # Causal: q-blocks strictly before this k-block see none of it.
-    start = (kj * block_k) // block_q if causal else 0
-    k_pos = _pos(block_k, kj, 0)  # [block_k, 1] (rows = k here)
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0][None, :]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0][None, :]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = (qi + 1) * block_q > kj * block_k if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        k_blk = k_ref[0, 0].astype(jnp.float32)  # [block_k, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0][None, :]  # [1, block_q]
+        delta = delta_ref[0, 0][:, 0][None, :]
         # Transposed layout: s_t [block_k, block_q].
         s_t = jax.lax.dot_general(
             k_blk, q * scale, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         if causal:
-            q_pos = _pos(block_q, i, 1)  # [1, block_q]
-            s_t = jnp.where(k_pos > q_pos, NEG_INF, s_t)
+            mask = _pos(block_k, kj, 0) > _pos(block_q, qi, 1)
+            s_t = jnp.where(mask, NEG_INF, s_t)
         p_t = jnp.exp(s_t - lse)  # [block_k, block_q]
-        dv_new = dv + jax.lax.dot_general(
+        dv_acc[...] += jax.lax.dot_general(
             p_t, do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -200,20 +217,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds_t = p_t * (dp_t - delta)
-        dk_new = dk + jax.lax.dot_general(
+        dk_acc[...] += jax.lax.dot_general(
             ds_t, q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk_new, dv_new
 
-    d = k_blk.shape[1]
-    dk, dv = jax.lax.fori_loop(
-        start, n_q, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)),
-    )
-    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _bwd(scale, causal, block_q, block_k, interpret, res, g):
@@ -225,24 +237,39 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
         do * out.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B, H, T, 1]
 
+    from jax.experimental.pallas import tpu as pltpu
+
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        grid=(b, h, t // block_q),
+        grid=(b, h, t // block_q, t // block_k),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)
+            ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)
+            (1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
@@ -251,22 +278,42 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
             _dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
         ),
-        grid=(b, h, t // block_k),
+        grid=(b, h, t // block_k, t // block_q),
         in_specs=[
-            pl.BlockSpec((1, 1, t, d), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, t, d), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, t, 1), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, t, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)
+            ),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, g, lse, delta)

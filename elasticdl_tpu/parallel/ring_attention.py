@@ -166,6 +166,14 @@ def inverse_order(order):
     return inv
 
 
+def zigzag_orders(t: int, n_shards: int):
+    """(order, inverse) pair for `layout="zigzag"` — the one helper both
+    ring_self_attention and mesh-aware models use, so the permute-around-
+    attend contract lives in one place."""
+    order = zigzag_order(t, n_shards)
+    return order, inverse_order(order)
+
+
 def _shard_positions(index, t_local, axis_size, layout):
     """Global positions of shard `index`'s local rows under `layout`."""
     if layout == "contiguous":
@@ -310,8 +318,7 @@ def ring_self_attention(
                 f"(got q={q.shape[1]}, k={k.shape[1]}, v={v.shape[1]}); "
                 "the balanced layout is a self-attention arrangement"
             )
-        order = zigzag_order(q.shape[1], mesh.shape[axis])
-        inv = inverse_order(order)
+        order, inv = zigzag_orders(q.shape[1], mesh.shape[axis])
         q, k, v = (x[:, order] for x in (q, k, v))
         q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
         return fn(q, k, v)[:, inv]
