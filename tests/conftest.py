@@ -23,3 +23,57 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def run_kill_recovery_job(
+    args, n_records, worker_env, log_dir, progress_fraction=8,
+    wait_timeout=480,
+):
+    """Shared kill-a-worker elasticity driver (used by the AllReduce and
+    context-parallel e2es): start a 2-worker job, wait for real progress,
+    SIGKILL the rank-1 worker (restart budget 0), and assert the world
+    shrank to ONE fresh worker while every record still trained."""
+    import time
+
+    from elasticdl_tpu.master.main import start_master
+    from elasticdl_tpu.master.pod_manager import (
+        LocalProcessManager,
+        worker_argv_from_args,
+    )
+    from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
+
+    rendezvous = ElasticRendezvous()
+    master = start_master(args, rendezvous_server=rendezvous)
+    manager = LocalProcessManager(
+        num_workers=2,
+        worker_argv_fn=worker_argv_from_args(args, master.addr),
+        rendezvous=rendezvous,
+        task_manager=master.task_manager,
+        max_restarts=0,
+        worker_env=worker_env,
+        log_dir=log_dir,
+        job_finished_fn=master.task_manager.finished,
+    )
+    try:
+        manager.start()
+        deadline = time.time() + 300
+        while (
+            master.task_manager.finished_record_count
+            < n_records // progress_fraction
+        ):
+            assert time.time() < deadline, "no progress before kill"
+            assert not master.task_manager.finished(), "finished too fast"
+            time.sleep(0.05)
+        victims = manager.current_worker_ids()
+        assert len(victims) == 2
+        manager.kill_worker(victims[1])
+        assert manager.wait(timeout=wait_timeout) is True
+        assert master.task_manager.finished()
+        assert master.task_manager.finished_record_count == n_records
+        # The world actually shrank: a relaunch happened with 1 FRESH
+        # worker (not the survivor continuing unperturbed).
+        assert manager.current_worker_ids() != victims
+        assert len(manager.current_worker_ids()) == 1
+    finally:
+        manager.stop()
+        master.stop()
