@@ -60,8 +60,8 @@ SELF_BASELINE = {
 def bench_deepfm(
     batch_size: int = 8192,
     vocab: int = 100_000,
-    steps_per_window: int = 400,  # amortizes per-dispatch host gap: 40
-    repeats: int = 5,             # -> 668k, 120 -> 757k, 400 -> 820k
+    steps_per_window: int = 800,  # amortizes per-dispatch host gap: 40
+    repeats: int = 5,             # -> 668k, 400 -> 827k, 800 -> 839k
 ):
     import jax
 
@@ -179,7 +179,7 @@ def bench_resnet50(
 
 
 def bench_transformer(
-    batch_size: int = 8,
+    batch_size: int = 16,  # B=8 -> 241k, B=16 -> 245k tokens/sec
     seq_len: int = 2048,
     steps_per_window: int = 20,
     repeats: int = 5,
