@@ -1,0 +1,94 @@
+"""Vectorized fixed-width record parsing — the data-plane throughput
+lever for binary (ETRF/recordio) datasets.
+
+The per-record Python hop caps a host reader at ~380k records/s
+(BASELINE.md data-plane section); CTR-scale jobs need millions.  For
+fixed-width binary records the whole fix is one numpy structured-dtype
+view: join a range of raw payloads and `np.frombuffer` them into
+columnar arrays in a single pass — no per-record Python.
+
+Usage (a zoo dataset_fn for Criteo-shaped ETRF files):
+
+    LAYOUT = RecordLayout([
+        ("dense", np.float32, 13),
+        ("cat", np.int32, 26),
+        ("label", np.uint8, 1),
+    ])
+    columns = LAYOUT.parse_batch(raw_records)   # dict of [n, k] arrays
+
+`Dataset.map_raw_batches(layout.parse_batch)` hooks it into the
+pipeline at batch granularity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+class RecordLayout:
+    """Schema of one fixed-width binary record: ordered
+    (name, dtype, count) fields, little-endian, packed."""
+
+    def __init__(self, fields: Sequence[Tuple[str, type, int]]):
+        if not fields:
+            raise ValueError("RecordLayout needs at least one field")
+        self.fields = [
+            (name, np.dtype(dtype).newbyteorder("<"), int(count))
+            for name, dtype, count in fields
+        ]
+        self._struct = np.dtype(
+            [(name, dt, (count,)) for name, dt, count in self.fields]
+        )
+
+    @property
+    def record_bytes(self) -> int:
+        return self._struct.itemsize
+
+    def pack(self, **values) -> bytes:
+        """One record dict -> bytes (the writer-side inverse; tests and
+        data generators use it)."""
+        row = np.zeros((), dtype=self._struct)
+        for name, dt, count in self.fields:
+            arr = np.asarray(values[name], dt).reshape(count)
+            row[name] = arr
+        return row.tobytes()
+
+    def parse_batch(self, raw_records: List[bytes]) -> Dict[str, np.ndarray]:
+        """Raw payload list -> {field: [n, count] array}, one numpy pass."""
+        buf = b"".join(raw_records)
+        n, rem = divmod(len(buf), self.record_bytes)
+        if rem or n != len(raw_records):
+            raise ValueError(
+                f"records are not fixed-width {self.record_bytes}B "
+                f"(got {len(buf)}B for {len(raw_records)} records)"
+            )
+        return self.parse_buffer(np.frombuffer(buf, np.uint8))
+
+    def parse_buffer(self, buf, lengths=None) -> Dict[str, np.ndarray]:
+        """Contiguous payload buffer (np.uint8) -> columnar arrays.
+
+        The zero-Python-per-record path: feed chunks straight from
+        `data.recordfile.read_range_buffers`.  `lengths` (when given) is
+        validated against the fixed record width."""
+        buf = np.ascontiguousarray(buf, np.uint8)
+        n, rem = divmod(buf.size, self.record_bytes)
+        if rem:
+            raise ValueError(
+                f"buffer size {buf.size} is not a multiple of the "
+                f"record width {self.record_bytes}"
+            )
+        if lengths is not None and (
+            len(lengths) != n
+            or not (np.asarray(lengths) == self.record_bytes).all()
+        ):
+            raise ValueError(
+                f"records are not fixed-width {self.record_bytes}B"
+            )
+        table = buf.view(self._struct)
+        # The view may alias a read-only buffer; copy so downstream may
+        # mutate.
+        return {
+            name: np.array(table[name]) for name, _, _ in self.fields
+        }

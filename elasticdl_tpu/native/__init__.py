@@ -264,7 +264,20 @@ class NativeRecordFile:
     CHUNK_BYTES = 128 * 1024 * 1024
 
     def read_range(self, path: str, start: int, end: int):
-        """Yield payload bytes of records [start, end) (CRC-checked)."""
+        """Yield payload bytes of records [start, end) (CRC-checked) —
+        a per-record splitter over read_range_buffers."""
+        for buf, lengths in self.read_range_buffers(path, start, end):
+            view = memoryview(buf)
+            offset = 0
+            for length in lengths:
+                yield bytes(view[offset : offset + int(length)])
+                offset += int(length)
+
+    def read_range_buffers(self, path: str, start: int, end: int):
+        """Yield (payloads np.uint8 buffer, lengths np.uint32) CHUNKS of
+        records [start, end) — payloads back-to-back, no per-record
+        Python objects (the vectorized data-plane path; see
+        data/vectorized.py)."""
         handle = self._lib.edl_rf_open(path.encode())
         if not handle:
             raise IOError(self._error())
@@ -297,11 +310,8 @@ class NativeRecordFile:
                 )
                 if read < 0:
                     raise IOError(self._error())
-                view = memoryview(buf)
-                offset = 0
-                for length in lengths[:read]:
-                    yield bytes(view[offset : offset + int(length)])
-                    offset += int(length)
+                used = int(lengths[:read].sum())
+                yield buf[:used], lengths[:read]
                 pos += read
         finally:
             self._lib.edl_rf_close(handle)
