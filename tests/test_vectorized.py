@@ -84,3 +84,73 @@ def test_parse_buffer_length_validation():
         LAYOUT.parse_buffer(buf, lengths=[1, 2, 3, 4])
     with pytest.raises(ValueError, match="multiple"):
         LAYOUT.parse_buffer(buf[:-1])
+
+
+def test_deepfm_trains_from_criteo_etrf_file(tmp_path):
+    """Binary-file ingestion e2e: a Criteo-layout ETRF file trains the
+    DeepFM config through the real CLI Local path via the vectorized
+    reader (loss decreases => parsing wired features correctly)."""
+    import subprocess
+    import sys
+
+    from model_zoo.deepfm.deepfm_functional_api import (
+        NUM_CAT,
+        NUM_DENSE,
+        criteo_record_layout,
+    )
+
+    layout = criteo_record_layout()
+    rng = np.random.RandomState(0)
+    n = 512
+    # Learnable structure: label depends on dense[0] and cat[0] parity.
+    recs = []
+    for _ in range(n):
+        dense = rng.rand(NUM_DENSE).astype(np.float32)
+        cat = rng.randint(0, 100, size=NUM_CAT).astype(np.int32)
+        label = int(dense[0] + 0.3 * (cat[0] % 2) > 0.65)
+        recs.append(layout.pack(dense=dense, cat=cat, label=[label]))
+    path = str(tmp_path / "criteo.etrf")
+    recordfile.write_records(path, recs)
+
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "elasticdl_tpu.client.main", "train",
+            "--distribution_strategy=Local",
+            "--model_zoo=model_zoo",
+            "--model_def=deepfm.deepfm_functional_api",
+            "--model_params=vocab_size=100",
+            f"--training_data={path}",
+            "--records_per_task=128",
+            "--num_epochs=4",
+            "--minibatch_size=32",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+            "ELASTICDL_FORCE_PLATFORM": "cpu",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import re
+
+    losses = [
+        float(m) for m in re.findall(r"loss=([0-9.]+)", proc.stderr)
+    ]
+    assert len(losses) >= 8
+    assert losses[-1] < losses[0] * 0.9, (losses[:2], losses[-2:])
+
+
+def test_criteo_reader_implements_reader_surface(tmp_path):
+    """The collective worker needs shard_names()/metadata (AbstractDataReader
+    surface) — the reader must not be Local-only."""
+    from model_zoo.deepfm.deepfm_functional_api import CriteoRecordReader
+
+    path = str(tmp_path / "s.etrf")
+    recordfile.write_records(path, _records(10))
+    reader = CriteoRecordReader(path)
+    assert reader.shard_names() == [path]
+    assert reader.create_shards() == {path: 10}
+    assert hasattr(reader, "metadata")
