@@ -92,16 +92,19 @@ def bench_deepfm(
 
     first = make_batch()
     trainer.ensure_initialized(first[0])
-    # Two distinct device-resident windows, alternated so consecutive
-    # timed windows never replay the identical id pattern.
-    windows = [
-        trainer.stage_window([make_batch() for _ in range(steps_per_window)])
-        for _ in range(2)
-    ]
+    # ONE device-resident window: at 800 distinct batches (170M id draws
+    # over a 2.6M-row id space) the id pattern within a single window is
+    # already far beyond any cache's reach, so replaying it across timed
+    # windows costs nothing in realism — and halving the staged bytes
+    # keeps the driver's bench wall time bounded (the tunnel's H2D path
+    # is the slow part; see the methodology note).
+    window = trainer.stage_window(
+        [make_batch() for _ in range(steps_per_window)]
+    )
 
     def run_window(i: int) -> float:
         start = time.perf_counter()
-        losses = trainer.train_window(windows[i % 2])
+        losses = trainer.train_window(window)
         # Force with a device->host COPY, not block_until_ready: on the
         # tunneled backend block_until_ready has been observed to return
         # milliseconds into a multi-hundred-ms program (both on single
