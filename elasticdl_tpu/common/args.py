@@ -129,9 +129,11 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "--mesh_model_axis", type=pos_int, default=1,
         help="Size of the mesh's `model` axis in cluster strategies "
         "(total devices = data x model). >1 shards embedding tables over "
-        "it (PS mode) and enables sequence/context parallelism — zoo "
-        "models whose custom_model() accepts `mesh` (e.g. "
-        "transformer.transformer_lm) run ring attention over this axis",
+        "it (PS mode) and gives mesh-aware zoo models (custom_model() "
+        "accepting `mesh`, e.g. transformer.transformer_lm) a parallel "
+        "axis: ring-attention context parallelism by default, or "
+        "Megatron-style tensor parallelism with "
+        "--model_params model_axis_mode=tp",
     )
     parser.add_argument("--task_timeout_s", type=non_neg_int, default=0)
     parser.add_argument(

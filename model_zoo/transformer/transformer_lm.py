@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from elasticdl_tpu.parallel.mesh import MODEL_AXIS
+from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from elasticdl_tpu.parallel.ring_attention import (
     blockwise_attention,
     make_ring_attention,
@@ -38,6 +38,22 @@ from model_zoo import datasets
 
 VOCAB = 256
 SEQ_LEN = 128
+
+
+def _tp_active(mesh, model_axis_mode: str) -> bool:
+    return (
+        model_axis_mode == "tp"
+        and mesh is not None
+        and mesh.shape.get(MODEL_AXIS, 1) > 1
+    )
+
+
+def _constrain(mesh, x, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec))
+    )
 
 
 class CausalSelfAttention(nn.Module):
@@ -50,6 +66,11 @@ class CausalSelfAttention(nn.Module):
     # Context-parallel sequence layout: "contiguous" or "zigzag" (the
     # balanced causal ring; see parallel/ring_attention.py).
     cp_layout: str = "contiguous"
+    # What the mesh's `model` axis carries: "cp" (ring attention over the
+    # sequence) or "tp" (Megatron-style tensor parallelism: heads and MLP
+    # hidden sharded over the axis via sharding constraints; GSPMD splits
+    # the matmuls and inserts the reduce).
+    model_axis_mode: str = "cp"
 
     def _single_device_attend(self, t: int, head_dim: int):
         from elasticdl_tpu.ops import flash_attention
@@ -71,12 +92,19 @@ class CausalSelfAttention(nn.Module):
                 f"attn_impl must be 'auto', 'pallas' or 'xla', "
                 f"got {self.attn_impl!r}"
             )
+        if self.model_axis_mode not in ("cp", "tp"):
+            raise ValueError(
+                f"model_axis_mode must be 'cp' or 'tp', "
+                f"got {self.model_axis_mode!r}"
+            )
         b, t, e = x.shape
         head_dim = e // self.num_heads
-        cp = (
+        sharded_axis = (
             self.mesh is not None
             and self.mesh.shape.get(MODEL_AXIS, 1) > 1
         )
+        cp = sharded_axis and self.model_axis_mode == "cp"
+        tp = sharded_axis and self.model_axis_mode == "tp"
         zigzag = cp and self.cp_layout == "zigzag"
         inv = None
         if zigzag:
@@ -95,6 +123,14 @@ class CausalSelfAttention(nn.Module):
         qkv = nn.DenseGeneral(
             (3, self.num_heads, head_dim), dtype=self.dtype, name="qkv"
         )(x)
+        if tp:
+            # Column-parallel qkv: heads shard over the model axis, so
+            # each device computes its heads' attention locally (the
+            # single-device kernels below partition head-wise under
+            # GSPMD; pallas custom calls don't, hence the xla path).
+            qkv = _constrain(
+                self.mesh, qkv, DATA_AXIS, None, None, MODEL_AXIS, None
+            )
         q, k, v = (qkv[:, :, i] for i in range(3))  # [B, T, H, D] each
         if cp:
             if self.attn_impl == "pallas":
@@ -106,13 +142,26 @@ class CausalSelfAttention(nn.Module):
             attend = make_ring_attention(
                 self.mesh, causal=True, layout=self.cp_layout
             )
+        elif tp:
+            if self.attn_impl == "pallas":
+                raise ValueError(
+                    "attn_impl='pallas' cannot partition over the model "
+                    "axis (custom calls are opaque to GSPMD); tensor-"
+                    "parallel attention runs the XLA blockwise engine"
+                )
+            attend = partial(blockwise_attention, causal=True)
         else:
             attend = self._single_device_attend(t, head_dim)
         out = attend(q, k, v)  # [B, T, H, D]
         if zigzag:
             out = out[:, inv]
         out = out.reshape(b, t, e)
-        return nn.Dense(e, dtype=self.dtype, name="proj")(out)
+        out = nn.Dense(e, dtype=self.dtype, name="proj")(out)
+        if tp:
+            # Row-parallel proj closes the TP block: output replicated
+            # over the model axis (GSPMD inserts the partial-sum reduce).
+            out = _constrain(self.mesh, out, DATA_AXIS, None, None)
+        return out
 
 
 class Block(nn.Module):
@@ -122,17 +171,25 @@ class Block(nn.Module):
     mesh: Any = None
     attn_impl: str = "auto"
     cp_layout: str = "contiguous"
+    model_axis_mode: str = "cp"
 
     @nn.compact
     def __call__(self, x):
         e = x.shape[-1]
-        h = nn.LayerNorm(dtype=self.dtype)(x)
-        x = x + CausalSelfAttention(
+        attn = CausalSelfAttention(
             self.num_heads, self.dtype, self.mesh, self.attn_impl,
-            self.cp_layout, name="attn",
-        )(h)
+            self.cp_layout, self.model_axis_mode, name="attn",
+        )
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + attn(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(e * self.mlp_ratio, dtype=self.dtype)(h)
+        if _tp_active(self.mesh, self.model_axis_mode):
+            # Column-parallel up-projection / row-parallel down-projection
+            # (the Megatron MLP): hidden shards over the model axis
+            # (batch stays on `data`), the residual add below stays
+            # replicated over `model`.
+            h = _constrain(self.mesh, h, DATA_AXIS, None, MODEL_AXIS)
         h = nn.gelu(h)
         return x + nn.Dense(e, dtype=self.dtype)(h)
 
@@ -147,6 +204,7 @@ class TransformerLM(nn.Module):
     mesh: Any = None
     attn_impl: str = "auto"
     cp_layout: str = "contiguous"
+    model_axis_mode: str = "cp"
     # Rematerialize each block's activations in backward (jax.checkpoint)
     # — trades ~30% more FLOPs for O(layers) less activation memory, the
     # standard long-context lever.
@@ -165,6 +223,7 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 self.num_heads, dtype=self.dtype, mesh=self.mesh,
                 attn_impl=self.attn_impl, cp_layout=self.cp_layout,
+                model_axis_mode=self.model_axis_mode,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
@@ -182,12 +241,17 @@ def custom_model(
     mesh: Optional[Any] = None,
     attn_impl: str = "auto",
     cp_layout: str = "contiguous",
+    model_axis_mode: str = "cp",
     remat: bool = False,
 ):
-    """`mesh=None` -> single-device blockwise attention; pass the
-    trainer's mesh (model axis > 1) for ring-attention context
-    parallelism.  The model-axis size must then divide the sequence
-    length (each device holds T / model_axis contiguous positions)."""
+    """`mesh=None` -> single-device attention (Pallas flash kernel on
+    TPU).  With the trainer's mesh and model axis > 1, `model_axis_mode`
+    picks what that axis carries: "cp" (default) runs ring-attention
+    context parallelism — the model-axis size must then divide the
+    sequence length (each device holds T / model_axis positions) — and
+    "tp" runs Megatron-style tensor parallelism (heads and MLP hidden
+    shard over the axis; no sequence-divisibility requirement, though
+    num_heads should divide the axis size for an even split)."""
     return TransformerLM(
         vocab=vocab,
         d_model=d_model,
@@ -198,6 +262,7 @@ def custom_model(
         mesh=mesh,
         attn_impl=attn_impl,
         cp_layout=cp_layout,
+        model_axis_mode=model_axis_mode,
         remat=remat,
     )
 
