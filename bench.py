@@ -128,8 +128,10 @@ def bench_deepfm(
 def bench_resnet50(
     batch_size: int = 128,  # scanned sweet spot on one v5e chip:
     image_size: int = 224,  # 64->2411, 128->2628, 192->2415, 256->2527,
-    steps_per_window: int = 64,  # 384->2379, 512->2301 img/s (BASELINE.md)
-    repeats: int = 5,
+    steps_per_window: int = 96,  # 384->2379, 512->2301 img/s (BASELINE.md)
+    repeats: int = 5,  # windows: 64 -> 2628-2642, 96 -> 2661 (0% spread),
+    # 128 -> 2676 but 4% spread (HBM pressure jitter); 96 wins on
+    # steadiness.
 ):
     import jax
     import ml_dtypes
