@@ -48,7 +48,16 @@ class ModelSpec:
             except (TypeError, ValueError):
                 accepts_mesh = False
             if accepts_mesh:
+                from elasticdl_tpu.common.log_utils import get_logger
+
                 params["mesh"] = mesh
+                # e2e tests grep this line to prove the mesh actually
+                # reached the model (TP/CP silently degrade to
+                # single-device layouts without it).
+                get_logger("common.model_utils").info(
+                    "Mesh-aware model: forwarding mesh %s",
+                    dict(mesh.shape),
+                )
         return self.custom_model(**params)
 
 

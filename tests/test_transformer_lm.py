@@ -80,11 +80,22 @@ def test_cp_and_single_device_agree():
     )
 
 
-def test_lm_context_parallel_cluster_e2e(tmp_path, monkeypatch):
-    """Full cluster path: 2 worker processes x 2 CPU devices = a 4-device
-    world, --mesh_model_axis=2 -> mesh 2x2 (data x model).  The sequence
-    ring spans PROCESS boundaries; the job must train every record and
-    write a checkpoint."""
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("extra_model_params", ["", ",model_axis_mode=tp"])
+def test_lm_cluster_e2e_cp_and_tp(tmp_path, monkeypatch, extra_model_params):
+    """Full cluster path, parametrized over what the model axis carries:
+    2 worker processes x 2 CPU devices = a 4-device world,
+    --mesh_model_axis=2 -> mesh 2x2 (data x model).
+
+    - default (cp): the sequence ring spans PROCESS boundaries;
+    - model_axis_mode=tp: GSPMD's tensor-parallel collectives run across
+      processes instead.
+
+    Both must train every record and write a checkpoint, and the worker
+    logs must show the mesh genuinely reached the model (without it the
+    model silently degrades to the single-device layout)."""
     import os
 
     from elasticdl_tpu.common.args import parse_master_args
@@ -106,7 +117,8 @@ def test_lm_context_parallel_cluster_e2e(tmp_path, monkeypatch):
     args = parse_master_args([
         "--model_zoo=model_zoo",
         "--model_def=transformer.transformer_lm",
-        "--model_params=d_model=32,num_layers=1,num_heads=2",
+        "--model_params=d_model=32,num_layers=1,num_heads=2"
+        + extra_model_params,
         "--training_data=synthetic://lm?n=64&len=32",
         "--records_per_task=32",
         "--minibatch_size=8",
@@ -120,6 +132,17 @@ def test_lm_context_parallel_cluster_e2e(tmp_path, monkeypatch):
     rc = run_allreduce_job(args, Mode.TRAINING)
     assert rc == 0
     assert any(p.startswith("step_") for p in os.listdir(tmp_path / "ckpt"))
+    # The mesh reached the model in every worker (see build_model's log).
+    log_root = next(
+        tmp_path / "ckpt" / d
+        for d in os.listdir(tmp_path / "ckpt")
+        if d.endswith("_worker_logs")
+    )
+    logs = "".join(
+        open(log_root / f).read() for f in os.listdir(log_root)
+    )
+    assert "Mesh-aware model: forwarding mesh" in logs
+
 
 
 def test_pallas_attn_impl_matches_xla():
