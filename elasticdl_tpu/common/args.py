@@ -120,6 +120,19 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "staged-batch memory and checkpoint granularity.",
     )
     parser.add_argument(
+        "--sparse_apply_every", type=pos_int, default=1,
+        help="ParameterServerStrategy only: apply the sparse embedding "
+        "optimizer once per N train steps from the accumulated gradients "
+        "(N=1 is strict per-step semantics). N>1 trades bounded "
+        "staleness — forwards within a chunk read chunk-start tables, "
+        "the async-PS behaviour of upstream ElasticDL — for amortizing "
+        "the table-sized moment update, the dominant step cost once the "
+        "per-chip table exceeds ~10M rows (BASELINE.md table-scale "
+        "probe). Chunks never span device dispatches: the worker grows "
+        "--train_window_steps to a multiple of N, and task-tail batches "
+        "outside a full window apply per-step.",
+    )
+    parser.add_argument(
         "--profile_steps", default="", type=_profile_steps_spec,
         help="'START,END': each worker captures a jax.profiler trace of "
         "its training steps in [START, END) under "

@@ -100,6 +100,7 @@ def strip_capture_collections(variables: dict) -> dict:
     return variables
 
 
+@pk.mark_iid  # fixed-scale uniform: safe to draw directly in packed shape
 def default_embedding_init(key, shape, dtype=jnp.float32):
     # Matches the reference's default 'uniform' Keras initializer scale.
     return jax.random.uniform(key, shape, dtype, -0.05, 0.05)

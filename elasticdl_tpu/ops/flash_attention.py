@@ -339,10 +339,29 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
+# The forward kernel keeps each (batch, head)'s FULL [T, D] K and V
+# resident in VMEM (the backward kernels stream block-wise).  Cap the K+V
+# footprint auto-mode will accept: 8 MiB leaves room for the Q/output
+# blocks and the f32 accumulators inside the default ~16 MiB scoped-VMEM
+# budget (T=16384 x D=64 sits exactly at the cap and is measured to work;
+# beyond it, lowering fails unless the operator raises
+# LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib).  Explicit
+# flash_attention() calls are not bounded — only supports(), which
+# attention_impl='auto' consults before preferring the kernel over
+# blockwise_attention.
+_KV_VMEM_BYTES_MAX = 8 * 1024 * 1024
+
+
 def supports(t: int, d: int, block: int = DEFAULT_BLOCK) -> bool:
-    """Whether the kernel handles this (seq_len, head_dim) shape."""
+    """Whether the kernel handles this (seq_len, head_dim) shape within
+    the default VMEM budget (see _KV_VMEM_BYTES_MAX)."""
     block = min(block, t)
-    return t % block == 0 and t % 8 == 0 and d % 8 == 0
+    return (
+        t % block == 0
+        and t % 8 == 0
+        and d % 8 == 0
+        and 2 * t * d * 4 <= _KV_VMEM_BYTES_MAX
+    )
 
 
 def flash_attention(

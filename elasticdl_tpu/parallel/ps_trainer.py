@@ -79,6 +79,7 @@ class ShardedEmbeddingTrainer:
         mesh,
         embedding_optimizer: Optional[SparseOptimizer] = None,
         seed: int = 0,
+        sparse_apply_every: int = 1,
     ):
         self._model = model
         self._loss_fn = loss_fn
@@ -91,6 +92,7 @@ class ShardedEmbeddingTrainer:
             )
             embedding_optimizer = sgd(0.01)
         self._emb_tx = embedding_optimizer
+        self._sparse_apply_every = max(1, int(sparse_apply_every))
         self._mesh = mesh
         self._seed = seed
         self._dp = shd.data_axis_size(mesh)
@@ -156,7 +158,13 @@ class ShardedEmbeddingTrainer:
         }
         slots = {
             key: {
-                name: self._table_sharding(np.shape(value)[0], np.ndim(value))
+                # Scalar slots (e.g. adam's global-bias step counter)
+                # replicate; table-shaped slots shard with their table.
+                name: (
+                    self._table_sharding(np.shape(value)[0], np.ndim(value))
+                    if np.ndim(value)
+                    else repl
+                )
                 for name, value in group.items()
             }
             for key, group in state.slots.items()
@@ -312,7 +320,9 @@ class ShardedEmbeddingTrainer:
             lambda s: jnp.zeros(s.shape, s.dtype), self._perturb_shapes
         )
 
-    def _train_step_impl(self, state: PSTrainState, features, labels, mask):
+    def _forward_backward(self, state: PSTrainState, features, labels, mask):
+        """One fwd/bwd: loss, mutated collections, dense + perturbation
+        (sparse embedding) gradients."""
         mutable_keys = list(state.model_state.keys()) + [IDS_COLLECTION]
 
         def compute_loss(params, perturbs):
@@ -333,31 +343,48 @@ class ShardedEmbeddingTrainer:
         (loss, muts), (dense_grads, perturb_grads) = jax.value_and_grad(
             compute_loss, argnums=(0, 1), has_aux=True
         )(state.params, self._zero_perturbations())
+        return loss, muts, dense_grads, perturb_grads
 
-        updates, new_opt_state = self._tx.update(
-            dense_grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
-
-        # Sparse apply per table: pair sown ids with perturbation grads.
+    def _sparse_batches(self, muts, perturb_grads, tables):
+        """Per table: (spec, flat ids, flat grads) from the sown id
+        collection + perturbation cotangents."""
         ids_tree = muts.get(IDS_COLLECTION, {})
-        new_tables = dict(state.tables)
-        new_slots = dict(state.slots)
         for key, module_path in self._table_paths.items():
             prefix = module_path[:-1]  # drop the 'embedding' param name
             spec = self._table_specs[key]
             ids = _collection_get(ids_tree, prefix, "ids")
             grad = _collection_get(perturb_grads, prefix, "bet")
             flat_ids = ids.reshape((-1,))
-            flat_grads = grad.reshape((-1, spec.dim)).astype(new_tables[key].dtype)
-            new_tables[key], new_slots[key] = self._emb_tx.apply(
-                spec, new_tables[key], new_slots[key], flat_ids, flat_grads
-            )
+            flat_grads = grad.reshape((-1, spec.dim)).astype(tables[key].dtype)
+            yield key, spec, flat_ids, flat_grads
 
+    def _dense_and_state(self, state, muts, dense_grads):
+        updates, new_opt_state = self._tx.update(
+            dense_grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
         new_model_state = (
             {k: muts[k] for k in state.model_state.keys() if k in muts}
             or state.model_state
         )
+        return new_params, new_opt_state, new_model_state
+
+    def _train_step_impl(self, state: PSTrainState, features, labels, mask):
+        loss, muts, dense_grads, perturb_grads = self._forward_backward(
+            state, features, labels, mask
+        )
+        new_params, new_opt_state, new_model_state = self._dense_and_state(
+            state, muts, dense_grads
+        )
+        # Sparse apply per table: pair sown ids with perturbation grads.
+        new_tables = dict(state.tables)
+        new_slots = dict(state.slots)
+        for key, spec, flat_ids, flat_grads in self._sparse_batches(
+            muts, perturb_grads, new_tables
+        ):
+            new_tables[key], new_slots[key] = self._emb_tx.apply(
+                spec, new_tables[key], new_slots[key], flat_ids, flat_grads
+            )
         return (
             PSTrainState(
                 state.step + 1,
@@ -370,17 +397,112 @@ class ShardedEmbeddingTrainer:
             loss,
         )
 
+    def _train_chunk_impl(self, state: PSTrainState, feats, labels, masks):
+        """W steps with per-step dense updates and ONE deferred sparse
+        apply (sparse_apply_every > 1).
+
+        The windowed relaxation: embedding grads accumulate into a packed
+        acc table across the chunk (duplicates sum, exactly the per-step
+        dedup contract) and the sparse optimizer applies ONCE per chunk
+        from the sum — so forwards within a chunk read the tables as of
+        the chunk start.  This is the reference's ASYNC-PS staleness
+        (workers there train on pulled snapshots while pushed grads land;
+        SURVEY §3.3), traded deliberately: the full-table streaming
+        moment update amortizes W-fold, which at the 26M-row north-star
+        probe is the difference between 184k and >500k samples/s/chip.
+        Dense params, batch stats, and the step counter still update
+        every step; strict per-step semantics remain the default (W=1).
+
+        Mechanically the chunk's (ids, grads) stream OUT of the scan and
+        feed one optimizer `apply` on the concatenated W-step batch —
+        NOT an accumulator table carried through the scan: XLA never
+        scatters into a loop carry in place, so a carried acc paid a
+        full table copy every step (measured 15.9 ms/step at the 26M
+        probe, worse than what the window was saving).  The scan outputs
+        cost W x batch-sized buffers instead (a few hundred MB at W=64).
+        """
+
+        def body(st, xs):
+            features, labels_, mask = xs
+            loss, muts, dense_grads, perturb_grads = self._forward_backward(
+                st, features, labels_, mask
+            )
+            new_params, new_opt_state, new_model_state = self._dense_and_state(
+                st, muts, dense_grads
+            )
+            sparse = {
+                key: (flat_ids, flat_grads)
+                for key, _, flat_ids, flat_grads in self._sparse_batches(
+                    muts, perturb_grads, st.tables
+                )
+            }
+            new_st = PSTrainState(
+                st.step + 1, new_params, new_opt_state, new_model_state,
+                st.tables, st.slots,
+            )
+            return new_st, (loss, sparse)
+
+        state, (losses, sparse) = jax.lax.scan(
+            body, state, (feats, labels, masks)
+        )
+        new_tables = dict(state.tables)
+        new_slots = dict(state.slots)
+        for key in self._table_paths:
+            spec = self._table_specs[key]
+            ids_w, grads_w = sparse[key]  # [W, n], [W, n, dim]
+            new_tables[key], new_slots[key] = self._emb_tx.apply(
+                spec, new_tables[key], new_slots[key],
+                ids_w.reshape((-1,)),
+                grads_w.reshape((-1, spec.dim)),
+            )
+        return state._replace(tables=new_tables, slots=new_slots), losses
+
     def _train_window_impl(self, state, feat_win, label_win, mask_win):
         """K train steps in ONE device program (lax.scan over the stacked
         window).  One dispatch + one transfer amortize per-call overheads
-        K-fold — the TPU-idiomatic device-side training loop."""
+        K-fold — the TPU-idiomatic device-side training loop.  With
+        sparse_apply_every=W > 1 the window runs as ceil(K/W) chunks (see
+        _train_chunk_impl)."""
+        W = self._sparse_apply_every
 
-        def body(st, xs):
-            features, labels, mask = xs
-            new_state, loss = self._train_step_impl(st, features, labels, mask)
-            return new_state, loss
+        if W <= 1:
+            def body(st, xs):
+                features, labels, mask = xs
+                new_state, loss = self._train_step_impl(
+                    st, features, labels, mask
+                )
+                return new_state, loss
 
-        return jax.lax.scan(body, state, (feat_win, label_win, mask_win))
+            return jax.lax.scan(body, state, (feat_win, label_win, mask_win))
+
+        K = jax.tree.leaves(feat_win)[0].shape[0]
+        n_full, rem = divmod(K, W)
+        losses_parts = []
+        if n_full:
+            chunked = jax.tree.map(
+                lambda x: x[: n_full * W].reshape(
+                    (n_full, W) + x.shape[1:]
+                ),
+                (feat_win, label_win, mask_win),
+            )
+
+            def chunk_body(st, xs):
+                return self._train_chunk_impl(st, *xs)
+
+            state, losses_full = jax.lax.scan(chunk_body, state, chunked)
+            losses_parts.append(losses_full.reshape((-1,)))
+        if rem:
+            tail = jax.tree.map(
+                lambda x: x[n_full * W:], (feat_win, label_win, mask_win)
+            )
+            state, losses_tail = self._train_chunk_impl(state, *tail)
+            losses_parts.append(losses_tail)
+        losses = (
+            jnp.concatenate(losses_parts)
+            if len(losses_parts) > 1
+            else losses_parts[0]
+        )
+        return state, losses
 
     def _eval_step_impl(self, state: PSTrainState, features):
         variables = {
@@ -481,8 +603,22 @@ class ShardedEmbeddingTrainer:
         out = {f"table|{k}": v for k, v in state.tables.items()}
         for key, group in state.slots.items():
             for name, v in group.items():
-                out[f"slot|{key}|{name}"] = v
+                if np.ndim(v):  # scalar slots ride the dense pickle instead
+                    out[f"slot|{key}|{name}"] = v
         return out
+
+    def _scalar_slots(self, state: PSTrainState) -> dict:
+        """Replicated 0-d slots (e.g. adam's global-bias counter): row-
+        interval sharding is meaningless for them, so they checkpoint with
+        the dense state."""
+        return {
+            key: {
+                name: jax.device_get(v)
+                for name, v in group.items()
+                if not np.ndim(v)
+            }
+            for key, group in state.slots.items()
+        }
 
     def save_checkpoint(self, saver, step: int) -> None:
         """COLLECTIVE sharded checkpoint (checkpoint/sharded.py): every
@@ -501,6 +637,7 @@ class ShardedEmbeddingTrainer:
                 "params": jax.device_get(state.params),
                 "opt_state": jax.device_get(state.opt_state),
                 "model_state": jax.device_get(state.model_state),
+                "scalar_slots": self._scalar_slots(state),
             }
         saver.save(step, dense, self._sharded_arrays(state))
 
@@ -524,10 +661,34 @@ class ShardedEmbeddingTrainer:
             k: saver.load_array(step, f"table|{k}", shardings.tables[k])
             for k in template.tables
         }
+        scalar_slots = dense.get("scalar_slots", {})
+
+        def load_scalar_slot(k, n, tmpl):
+            # Fail LOUDLY if the checkpoint predates this slot (e.g. a
+            # per_row-bias adam checkpoint restored into a global-bias
+            # build): silently defaulting the counter to 0 would reset
+            # bias correction on a converged model.
+            if n not in scalar_slots.get(k, {}):
+                raise ValueError(
+                    f"Checkpoint at step {step} has no scalar slot "
+                    f"{k}/{n} — it was written by a build with a "
+                    "different optimizer configuration (e.g. adam "
+                    "bias_correction='per_row' vs 'global'); restore "
+                    "with the matching configuration"
+                )
+            return self._place_leaf(
+                np.asarray(scalar_slots[k][n], dtype=np.asarray(tmpl).dtype),
+                shardings.slots[k][n],
+            )
+
         slots = {
             k: {
-                n: saver.load_array(
-                    step, f"slot|{k}|{n}", shardings.slots[k][n]
+                n: (
+                    load_scalar_slot(k, n, group[n])
+                    if not np.ndim(group[n])
+                    else saver.load_array(
+                        step, f"slot|{k}|{n}", shardings.slots[k][n]
+                    )
                 )
                 for n in group
             }
@@ -538,6 +699,19 @@ class ShardedEmbeddingTrainer:
                 f"Checkpoint table {k} shape {v.shape} != model "
                 f"{template.tables[k].shape} (vocab/dim changed?)"
             )
+        for k, group in slots.items():
+            for n, v in group.items():
+                tmpl = template.slots[k][n]
+                # .shape/.dtype only — never np.asarray a sharded slot
+                # (that would gather the full table to host).
+                got = (tuple(np.shape(v)), np.dtype(v.dtype))
+                want = (tuple(np.shape(tmpl)), np.dtype(tmpl.dtype))
+                assert got == want, (
+                    f"Checkpoint slot {k}/{n} is {got} but this build "
+                    f"expects {want} — slot layouts changed (e.g. adam "
+                    "'t' moved from flat i32 to packed lane f32 in round "
+                    "3); re-train or migrate the checkpoint"
+                )
         if hasattr(saver, "release"):
             saver.release(step)  # close shard-file handles; restore done
         self._host_step = int(np.asarray(dense["step"]))

@@ -15,12 +15,23 @@ TPU design (round 2 rewrite — the round-1 version cost 2.9x):
   three table-sized scatters ~6.3 ms on the DeepFM step.
 - Duplicate-id handling is a packed scatter-add segment-sum
   (`grad_accumulate`) — no argsort, no per-row gather/update/scatter.
-- Moment/accumulator updates STREAM over the whole table with a
-  touched-row mask (elementwise, perfectly tiled, sharded with the table
-  — zero communication) instead of gathering the touched rows.  Per-step
-  cost is O(table_size / n_devices) sequential HBM traffic, which for
-  lane-packed tables beats the random-access row updates by >10x; the
-  measured DeepFM-Adam step went 30 ms -> 2 ms on one chip.
+- Moment/accumulator updates have TWO paths, selected per table at trace
+  time (mode="auto"):
+  * STREAM: one elementwise pass over the whole table with a touched-row
+    mask (perfectly tiled, sharded with the table — zero communication).
+    Per-step cost is O(table_size / n_devices) sequential HBM traffic,
+    which for lane-packed tables beats random-access row updates by >10x
+    at small table sizes; the measured DeepFM-Adam step went 30 ms ->
+    2 ms on one chip (2.6M rows).
+  * SCATTER (lazy, round 3): sort-free dedup of the batch ids
+    (packed.dedup_representatives — two O(n) scatters plus one O(vocab)
+    i32 buffer), then gather/update/scatter ONLY the touched rows.
+    O(batch) instead of O(table): at the north-star 26M resident rows the
+    streaming pass had collapsed DeepFM from 839k to 192k samples/s; this
+    path removes the table-size term entirely.
+  The auto crossover (streaming below ~8 batch-sized table passes,
+  scatter above) is set from measurements on the v5e chip; see
+  _use_scatter below.
 
 Semantics (identical to round 1 and to the TF sparse-apply contract):
 - Duplicate ids within a step contribute their SUMMED gradient and cause
@@ -33,7 +44,7 @@ Semantics (identical to round 1 and to the TF sparse-apply contract):
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -58,6 +69,17 @@ class SparseOptimizer:
     init_slots: Callable[..., Dict[str, jnp.ndarray]]
     apply: Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
     hyperparams: dict = field(default_factory=dict)
+    # apply_acc(spec, packed_table, slots, acc) -> (table, slots): one
+    # optimizer step from an ALREADY-ACCUMULATED packed gradient table
+    # (grad_accumulate output).  Semantically identical to apply() on the
+    # batch that produced the acc — the dedup contract makes the two
+    # interchangeable (pinned by test_apply_acc_matches_apply).  NOTE the
+    # trainer's windowed path (ps_trainer sparse_apply_every > 1) calls
+    # apply() on the chunk's CONCATENATED (ids, grads), not this — an acc
+    # table carried through the step scan costs a full table copy per
+    # step (BASELINE.md).  apply_acc serves host-side/offline applies and
+    # callers that already hold an accumulated gradient table.
+    apply_acc: Optional[Callable] = None
 
     # -- logical-shape conveniences (tests, host tools) -----------------
 
@@ -76,10 +98,55 @@ class SparseOptimizer:
 
 
 def _t_slot_shape(spec: PackedSpec) -> tuple:
-    # Per-row step counts as a FLAT [vocab_padded] i32 (1-D arrays tile
-    # T(1024) with no lane padding; a [blocks, R] i32 would pad R -> 128
-    # lanes and waste 128/R x HBM).
-    return (spec.vocab_padded,)
+    # Per-row step counts stored as f32 BROADCAST LANES: same packed shape
+    # as the table, each row's count repeated across its dim lanes.  The
+    # round-2 flat [vocab_padded] i32 layout was 8x smaller but cost two
+    # physical reshape copies per step (measured 3.1 ms/step/table at the
+    # 26M-row probe: XLA materializes [vocab] <-> [blocks, R] relayouts)
+    # and kept the t update out of the fused m/v/table pass.  Lane-shaped
+    # t joins that multi-output fusion and needs no reshapes; f32 counts
+    # are exact to 2^24 steps.
+    return (spec.num_blocks, spec.block_width)
+
+
+# Auto mode: measured on the v5e chip at the 26M-row probe (BASELINE.md):
+# the streaming pass costs ~27 ns per storage block per step; the scatter
+# path is count-bound at ~0.4 us per batch id (dedup buffer RMW + row
+# gathers/scatters) — at num_blocks = 15 x n_ids it still measured 2x
+# SLOWER than streaming (91 ms vs ~45 ms per step).  Require a wide
+# margin before switching: scatter only pays for huge-vocab/small-batch
+# regimes (e.g. online-style batches against Criteo-scale tables).
+_SCATTER_CROSSOVER = 64
+
+
+def _use_scatter(spec: PackedSpec, n_ids: int, mode: str) -> bool:
+    if mode == "scatter":
+        return True
+    if mode == "stream":
+        return False
+    if mode != "auto":
+        raise ValueError(f"mode must be auto|stream|scatter, got {mode!r}")
+    return spec.num_blocks > _SCATTER_CROSSOVER * n_ids
+
+
+def _dual_apply(mode: str, stream_apply_acc, scatter_apply):
+    """The apply dispatcher shared by every slotted optimizer: streaming
+    (grad_accumulate + the acc-consuming core) vs touched-rows scatter,
+    chosen per _use_scatter."""
+
+    def stream_apply(spec, packed_table, slots, ids, grads):
+        acc = pk.grad_accumulate(spec, packed_table, ids, grads)
+        return stream_apply_acc(spec, packed_table, slots, acc)
+
+    def apply(spec, packed_table, slots, ids, grads):
+        impl = (
+            scatter_apply
+            if _use_scatter(spec, ids.shape[0], mode)
+            else stream_apply
+        )
+        return impl(spec, packed_table, slots, ids, grads)
+
+    return apply
 
 
 def sgd(learning_rate: float = 0.01) -> SparseOptimizer:
@@ -91,19 +158,28 @@ def sgd(learning_rate: float = 0.01) -> SparseOptimizer:
     def apply(spec, packed_table, slots, ids, grads):
         return pk.scatter_add(spec, packed_table, ids, -lr * grads), slots
 
-    return SparseOptimizer("sgd", init_slots, apply, {"learning_rate": lr})
+    def apply_acc(spec, packed_table, slots, acc):
+        # SGD is linear in the gradient, so the windowed apply is EXACTLY
+        # the sum of the per-step applies.
+        return packed_table - lr * acc, slots
+
+    return SparseOptimizer(
+        "sgd", init_slots, apply, {"learning_rate": lr}, apply_acc
+    )
 
 
 def momentum(
-    learning_rate: float = 0.01, mu: float = 0.9, nesterov: bool = False
+    learning_rate: float = 0.01,
+    mu: float = 0.9,
+    nesterov: bool = False,
+    mode: str = "auto",
 ) -> SparseOptimizer:
     lr = learning_rate
 
     def init_slots(spec, packed_table):
         return {"momentum": jnp.zeros_like(packed_table)}
 
-    def apply(spec, packed_table, slots, ids, grads):
-        acc = pk.grad_accumulate(spec, packed_table, ids, grads)
+    def stream_apply_acc(spec, packed_table, slots, acc):
         touched = pk.broadcast_rows(spec, pk.touched_mask(spec, acc)).astype(
             packed_table.dtype
         )
@@ -114,27 +190,55 @@ def momentum(
         new_table = packed_table - lr * touched * step
         return new_table, {"momentum": v_new}
 
+    def scatter_apply(spec, packed_table, slots, ids, grads):
+        uids, gsum, touched = pk.dedup_representatives(spec, ids, grads)
+        tch = touched.astype(packed_table.dtype)[:, None]  # [n, 1]
+        gsum = gsum * tch
+        v_rows = pk.lookup(spec, slots["momentum"], uids)
+        v_new_rows = mu * v_rows + gsum
+        step = (mu * v_new_rows + gsum) if nesterov else v_new_rows
+        new_v = pk.scatter_add(spec, slots["momentum"], uids,
+                               (v_new_rows - v_rows) * tch)
+        new_table = pk.scatter_add(spec, packed_table, uids, -lr * tch * step)
+        return new_table, {"momentum": new_v}
+
     return SparseOptimizer(
-        "momentum", init_slots, apply,
+        "momentum", init_slots,
+        _dual_apply(mode, stream_apply_acc, scatter_apply),
         {"learning_rate": lr, "momentum": mu, "nesterov": nesterov},
+        stream_apply_acc,
     )
 
 
-def adagrad(learning_rate: float = 0.01, epsilon: float = 1e-7) -> SparseOptimizer:
+def adagrad(
+    learning_rate: float = 0.01, epsilon: float = 1e-7, mode: str = "auto"
+) -> SparseOptimizer:
     lr = learning_rate
 
     def init_slots(spec, packed_table):
         return {"accumulator": jnp.zeros_like(packed_table)}
 
-    def apply(spec, packed_table, slots, ids, grads):
-        acc = pk.grad_accumulate(spec, packed_table, ids, grads)
+    def stream_apply_acc(spec, packed_table, slots, acc):
         new_acc = slots["accumulator"] + acc * acc
         update = -lr * acc / (jnp.sqrt(new_acc) + epsilon)
         return packed_table + update, {"accumulator": new_acc}
 
+    def scatter_apply(spec, packed_table, slots, ids, grads):
+        uids, gsum, touched = pk.dedup_representatives(spec, ids, grads)
+        tch = touched.astype(packed_table.dtype)[:, None]
+        gsum = gsum * tch
+        acc_rows = pk.lookup(spec, slots["accumulator"], uids)
+        new_acc_rows = acc_rows + gsum * gsum
+        update = -lr * gsum / (jnp.sqrt(new_acc_rows) + epsilon)
+        new_acc = pk.scatter_add(spec, slots["accumulator"], uids, gsum * gsum)
+        new_table = pk.scatter_add(spec, packed_table, uids, update)
+        return new_table, {"accumulator": new_acc}
+
     return SparseOptimizer(
-        "adagrad", init_slots, apply,
+        "adagrad", init_slots,
+        _dual_apply(mode, stream_apply_acc, scatter_apply),
         {"learning_rate": lr, "epsilon": epsilon},
+        stream_apply_acc,
     )
 
 
@@ -143,29 +247,56 @@ def adam(
     beta_1: float = 0.9,
     beta_2: float = 0.999,
     epsilon: float = 1e-8,
+    mode: str = "auto",
+    bias_correction: str = "per_row",
 ) -> SparseOptimizer:
+    """Sparse Adam.
+
+    bias_correction:
+    - "per_row" (default): each row's correction uses ITS OWN touch count
+      (lazy semantics; matches the golden native-kernel contract).  Costs
+      a table-sized `t` slot plus its share of the streaming pass.
+    - "global": correction uses one shared apply counter — what the
+      reference's Go Adam actually does (†pkg/optimizer adam with a global
+      step; TF's Adam on sparse grads behaves the same).  Rows first
+      touched late are slightly over-corrected, and the table-sized `t`
+      slot disappears — at the 26M-row probe that is 1.66 GB of HBM and
+      ~3 ms/step of streaming traffic.
+    """
     lr = learning_rate
+    if bias_correction not in ("per_row", "global"):
+        raise ValueError(
+            f"bias_correction must be per_row|global, got {bias_correction!r}"
+        )
+    per_row = bias_correction == "per_row"
 
     def init_slots(spec, packed_table):
-        return {
+        slots = {
             "m": jnp.zeros_like(packed_table),
             "v": jnp.zeros_like(packed_table),
-            # Per-row step count for bias correction (the reference's Go
-            # Adam keeps a global step; per-row matches lazy semantics).
-            "t": jnp.zeros(_t_slot_shape(spec), jnp.int32),
         }
+        if per_row:
+            # Lane-broadcast f32 layout — see _t_slot_shape.
+            slots["t"] = jnp.zeros(_t_slot_shape(spec), jnp.float32)
+        else:
+            slots["t_global"] = jnp.zeros((), jnp.float32)
+        return slots
 
-    def apply(spec, packed_table, slots, ids, grads):
-        acc = pk.grad_accumulate(spec, packed_table, ids, grads)
-        touched_rows = pk.touched_mask(spec, acc)  # [blocks, R] bool
-        t_new = slots["t"] + touched_rows.reshape((-1,)).astype(jnp.int32)
-        touched = pk.broadcast_rows(spec, touched_rows).astype(packed_table.dtype)
-        t_rows = pk.broadcast_rows(
-            spec,
-            jnp.maximum(t_new, 1)
-            .reshape((spec.num_blocks, spec.rows_per_block))
-            .astype(packed_table.dtype),
+    def stream_apply_acc(spec, packed_table, slots, acc):
+        touched = pk.broadcast_rows(spec, pk.touched_mask(spec, acc)).astype(
+            packed_table.dtype
         )
+        new_slots = {}
+        if per_row:
+            # Pad lanes stay zero (scatter mode's expand_updates zero-pads).
+            t_new = slots["t"] + touched * pk.real_lane_mask(
+                spec, packed_table.dtype
+            )
+            t_rows = jnp.maximum(t_new, 1.0)
+            new_slots["t"] = t_new
+        else:
+            t_rows = slots["t_global"] + 1.0
+            new_slots["t_global"] = t_rows
         m_new = touched * (beta_1 * slots["m"] + (1 - beta_1) * acc) + (
             1 - touched
         ) * slots["m"]
@@ -175,12 +306,46 @@ def adam(
         m_hat = m_new / (1 - beta_1 ** t_rows)
         v_hat = v_new / (1 - beta_2 ** t_rows)
         update = -lr * touched * m_hat / (jnp.sqrt(v_hat) + epsilon)
-        return packed_table + update, {"m": m_new, "v": v_new, "t": t_new}
+        new_slots["m"] = m_new
+        new_slots["v"] = v_new
+        return packed_table + update, new_slots
+
+    def scatter_apply(spec, packed_table, slots, ids, grads):
+        uids, gsum, touched = pk.dedup_representatives(spec, ids, grads)
+        tch = touched.astype(packed_table.dtype)[:, None]
+        gsum = gsum * tch
+        m_rows = pk.lookup(spec, slots["m"], uids)
+        v_rows = pk.lookup(spec, slots["v"], uids)
+        new_slots = {}
+        if per_row:
+            t_rows = pk.lookup(spec, slots["t"], uids)[:, :1]  # [n, 1]
+            tr = jnp.maximum(t_rows + tch, 1.0)
+            new_slots["t"] = pk.scatter_add(
+                spec, slots["t"], uids,
+                jnp.broadcast_to(tch, (tch.shape[0], spec.dim)),
+            )
+        else:
+            t_global = slots["t_global"] + 1.0
+            tr = t_global
+            new_slots["t_global"] = t_global
+        m_new_rows = beta_1 * m_rows + (1 - beta_1) * gsum
+        v_new_rows = beta_2 * v_rows + (1 - beta_2) * gsum * gsum
+        m_hat = m_new_rows / (1 - beta_1 ** tr)
+        v_hat = v_new_rows / (1 - beta_2 ** tr)
+        update = -lr * tch * m_hat / (jnp.sqrt(v_hat) + epsilon)
+        new_slots["m"] = pk.scatter_add(spec, slots["m"], uids,
+                                        (m_new_rows - m_rows) * tch)
+        new_slots["v"] = pk.scatter_add(spec, slots["v"], uids,
+                                        (v_new_rows - v_rows) * tch)
+        new_table = pk.scatter_add(spec, packed_table, uids, update)
+        return new_table, new_slots
 
     return SparseOptimizer(
-        "adam", init_slots, apply,
+        "adam", init_slots,
+        _dual_apply(mode, stream_apply_acc, scatter_apply),
         {"learning_rate": lr, "beta_1": beta_1, "beta_2": beta_2,
-         "epsilon": epsilon},
+         "epsilon": epsilon, "bias_correction": bias_correction},
+        stream_apply_acc,
     )
 
 

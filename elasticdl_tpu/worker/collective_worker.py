@@ -71,6 +71,22 @@ class CollectiveWorker:
         self._profiler = profiler
         # Batches per device dispatch (see WINDOW below); 0 = default.
         self._window_steps = int(train_window_steps) or self.WINDOW
+        # The windowed sparse apply (ps_trainer sparse_apply_every) chunks
+        # WITHIN one dispatch window — accumulation never spans dispatches,
+        # and batches routed through the per-step tail program apply
+        # strictly.  A window smaller than the apply interval silently
+        # halves (or worse) the promised amortization, so grow the window
+        # to match and say so.
+        apply_every = int(getattr(trainer, "_sparse_apply_every", 1) or 1)
+        if apply_every > 1 and self._window_steps % apply_every:
+            grown = -(-self._window_steps // apply_every) * apply_every
+            logger.warning(
+                "Dispatch window %d is not a multiple of "
+                "sparse_apply_every=%d; growing the window to %d so every "
+                "chunk reaches the configured apply interval",
+                self._window_steps, apply_every, grown,
+            )
+            self._window_steps = grown
         # Pinned from the first task (standard task size) so the job
         # compiles ONE fused-scan executable; smaller (tail) tasks fall
         # back to the already-compiled per-step program instead of

@@ -109,6 +109,7 @@ def _build_collective_worker(
                 if model_spec.embedding_optimizer is not None
                 else None
             ),
+            sparse_apply_every=getattr(args, "sparse_apply_every", 1),
         )
     else:
         trainer = DataParallelTrainer(

@@ -43,18 +43,18 @@ class DeepFM(nn.Module):
         flat_ids = cats + offsets[None, :]
         total_vocab = self.vocab_size * cats.shape[-1]
 
-        # First-order terms: dim-1 embedding per categorical id + linear on
-        # the numeric fields.
-        first_cat = Embedding(
-            total_vocab, 1, combiner="sum", name="linear_embedding"
-        )(flat_ids)[..., 0]
+        # ONE merged table of dim 1+d: lane 0 is the first-order (linear)
+        # weight, lanes 1..d the FM/deep field vector.  The reference keeps
+        # two tables (linear + fm); merging them halves the count-bound
+        # sparse costs — one lookup gather and one grad scatter-add per
+        # step instead of two (measured ~25 ns/row each on the v5e chip,
+        # the dominant per-step device cost at every table scale).
         first_dense = nn.Dense(1, name="linear_dense")(dense)[..., 0]
-
-        # Field embeddings for FM + deep: categorical via the sharded
-        # table, numeric projected per-field to the same dim.
-        cat_emb = Embedding(
-            total_vocab, self.embedding_dim, name="fm_embedding"
-        )(flat_ids)                                          # [B, 26, d]
+        merged = Embedding(
+            total_vocab, 1 + self.embedding_dim, name="fm_embedding"
+        )(flat_ids)                                          # [B, 26, 1+d]
+        first_cat = jnp.sum(merged[..., 0], axis=-1)         # [B]
+        cat_emb = merged[..., 1:]                            # [B, 26, d]
         dense_emb = nn.DenseGeneral(
             (NUM_DENSE, self.embedding_dim), axis=-1, name="dense_projection"
         )(dense[:, None, :])[:, 0]                           # [B, 13, d]

@@ -126,8 +126,11 @@ def test_sparse_kernels_match_jax_path(kernels, data, name):
         np.testing.assert_allclose(
             m, np.asarray(pk.unpack(spec, slots["m"])), rtol=1e-4, atol=1e-6
         )
+        # t is stored lane-broadcast (packed table shape); column 0 of the
+        # unpacked logical view is the per-row step count.
         np.testing.assert_allclose(
-            t_rows, np.asarray(slots["t"]).astype(np.int64)[:VOCAB]
+            t_rows,
+            np.asarray(pk.unpack(spec, slots["t"]))[:, 0].astype(np.int64),
         )
 
 

@@ -64,6 +64,26 @@ def test_grad_accumulate_and_touched_mask():
     assert touched[1] and not touched[30] and not touched[0]
 
 
+@pytest.mark.parametrize("vocab,dim", [(32, 8), (16, 200)])
+def test_scatter_add_drops_negative_ids(vocab, dim):
+    """Regression: JAX scatters WRAP negative indices numpy-style (while
+    dropping positive OOB), so an unmasked padding id of -1 used to add
+    its grad into the LAST storage block.  Padding ids must be dropped."""
+    spec = PackedSpec(vocab, dim)
+    table = np.zeros((vocab, dim), np.float32)
+    ids = np.array([-1, -5, vocab + 9], np.int32)
+    updates = np.ones((3, dim), np.float32)
+    packed = pk.scatter_add(
+        spec, pk.pack(spec, table), jnp.asarray(ids), jnp.asarray(updates)
+    )
+    np.testing.assert_array_equal(np.asarray(pk.unpack(spec, packed)), table)
+    acc = pk.grad_accumulate(
+        spec, jnp.zeros(spec.packed_shape, jnp.float32), jnp.asarray(ids),
+        jnp.asarray(updates),
+    )
+    assert not np.asarray(pk.touched_mask(spec, acc)).any()
+
+
 def test_wide_rows_pass_through():
     """dim >= 128 needs no packing: R == 1, lookup is a plain row gather."""
     spec = PackedSpec(16, 200)

@@ -1,0 +1,229 @@
+"""Touched-rows (scatter/lazy) sparse optimizers vs the streaming path.
+
+Round-3 scale fix: the streaming moment updates are O(local-table) per
+step, which collapsed DeepFM at the north-star 26M-row table (VERDICT
+round 2, #1).  The scatter path (packed.dedup_representatives + gather/
+update/scatter of touched rows) must preserve the exact sparse-apply
+contract the golden tests pin (parity: the reference's Eigen
+`*SparseApply` kernels, elasticdl/pkg/kernel/capi via pkg/optimizer):
+
+- duplicate ids contribute their SUMMED gradient, one slot update;
+- rows whose summed gradient is exactly zero are untouched (no decay);
+- out-of-bounds ids (negative padding, >= vocab) are dropped.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.parallel import packed as pk
+from elasticdl_tpu.parallel import sparse_optim
+from elasticdl_tpu.parallel.packed import PackedSpec
+
+
+def _ids_with_edges(rng, vocab, n):
+    """ids covering every edge: duplicates, negatives, >= vocab OOB."""
+    ids = rng.randint(0, vocab, size=n).astype(np.int32)
+    ids[0] = ids[1]  # duplicate pair
+    ids[2] = -1  # padding id
+    ids[3] = vocab + 1000  # OOB high
+    return ids
+
+
+def test_dedup_representatives_matches_numpy():
+    spec = PackedSpec(64, 8)
+    rng = np.random.RandomState(0)
+    n = 24
+    ids = _ids_with_edges(rng, 64, n)
+    grads = rng.randn(n, 8).astype(np.float32)
+    # Make one valid row sum exactly to zero (cancelling duplicates).
+    ids[4] = ids[5] = 50
+    grads[5] = -grads[4]
+
+    safe, gsum, touched = pk.dedup_representatives(
+        spec, jnp.asarray(ids), jnp.asarray(grads)
+    )
+    safe, gsum, touched = map(np.asarray, (safe, gsum, touched))
+
+    valid = (ids >= 0) & (ids < spec.vocab_padded)
+    # Exactly one representative per distinct valid id with nonzero sum.
+    for row in np.unique(ids[valid]):
+        expect = grads[ids == row].sum(axis=0)
+        reprs = np.flatnonzero(touched & (ids == row))
+        if np.allclose(expect, 0):
+            assert reprs.size == 0, f"zero-sum row {row} must stay untouched"
+        else:
+            assert reprs.size == 1, f"row {row} needs exactly one representative"
+            np.testing.assert_allclose(gsum[reprs[0]], expect, rtol=1e-6)
+            assert safe[reprs[0]] == row
+    # Invalid positions never touched.
+    assert not touched[~valid].any()
+
+
+_OPTS = {
+    "momentum": lambda mode: sparse_optim.momentum(0.1, mu=0.9, mode=mode),
+    "nesterov": lambda mode: sparse_optim.momentum(
+        0.1, mu=0.9, nesterov=True, mode=mode
+    ),
+    "adagrad": lambda mode: sparse_optim.adagrad(0.1, mode=mode),
+    "adam": lambda mode: sparse_optim.adam(0.01, mode=mode),
+    "adam_global": lambda mode: sparse_optim.adam(
+        0.01, mode=mode, bias_correction="global"
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_OPTS))
+@pytest.mark.parametrize("vocab,dim", [(64, 8), (100, 4), (33, 5)])
+def test_scatter_matches_stream_multi_step(name, vocab, dim):
+    """Both paths produce the same table and slots over several steps with
+    duplicate / zero-sum / padding / OOB ids in the mix."""
+    rng = np.random.RandomState(7)
+    table0 = rng.randn(vocab, dim).astype(np.float32)
+
+    results = {}
+    for mode in ("stream", "scatter"):
+        opt = _OPTS[name](mode)
+        table = jnp.asarray(table0)
+        slots = opt.init_slots_logical(table)
+        for step in range(4):
+            srng = np.random.RandomState(100 + step)
+            n = 20
+            ids = _ids_with_edges(srng, vocab, n)
+            grads = srng.randn(n, dim).astype(np.float32)
+            ids[4] = ids[5] = 7
+            grads[5] = -grads[4]  # row 7 sums to zero -> untouched
+            table, slots = opt.apply_logical(
+                table, slots, jnp.asarray(ids), jnp.asarray(grads)
+            )
+        results[mode] = (np.asarray(table), {k: np.asarray(v) for k, v in slots.items()})
+
+    t_stream, s_stream = results["stream"]
+    t_scatter, s_scatter = results["scatter"]
+    np.testing.assert_allclose(t_scatter, t_stream, rtol=1e-5, atol=1e-6)
+    assert sorted(s_stream) == sorted(s_scatter)
+    for key in s_stream:
+        np.testing.assert_allclose(
+            s_scatter[key], s_stream[key], rtol=1e-5, atol=1e-6,
+            err_msg=f"slot {key} diverged",
+        )
+
+
+@pytest.mark.parametrize("name", ["sgd", "momentum", "adagrad", "adam"])
+def test_apply_acc_matches_apply(name):
+    """One apply_acc from an accumulated-gradient table == one apply from
+    the raw (ids, grads) batch — the contract the windowed sparse-apply
+    (ps_trainer sparse_apply_every) is built on."""
+    vocab, dim = 64, 8
+    spec = PackedSpec(vocab, dim)
+    rng = np.random.RandomState(11)
+    table0 = rng.randn(vocab, dim).astype(np.float32)
+    ids = _ids_with_edges(rng, vocab, 20)
+    grads = rng.randn(20, dim).astype(np.float32)
+
+    opts = {
+        "sgd": sparse_optim.sgd(0.1),
+        "momentum": sparse_optim.momentum(0.1),
+        "adagrad": sparse_optim.adagrad(0.1),
+        "adam": sparse_optim.adam(0.01),
+    }
+    opt = opts[name]
+    packed = pk.pack(spec, jnp.asarray(table0))
+    slots = opt.init_slots(spec, packed)
+
+    t_apply, s_apply = opt.apply(
+        spec, packed, slots, jnp.asarray(ids), jnp.asarray(grads)
+    )
+    acc = pk.grad_accumulate(
+        spec, packed, jnp.asarray(ids), jnp.asarray(grads)
+    )
+    t_acc, s_acc = opt.apply_acc(spec, packed, slots, acc)
+    np.testing.assert_allclose(
+        np.asarray(t_acc), np.asarray(t_apply), rtol=1e-6, atol=1e-7
+    )
+    for key in s_apply:
+        np.testing.assert_allclose(
+            np.asarray(s_acc[key]), np.asarray(s_apply[key]),
+            rtol=1e-6, atol=1e-7,
+        )
+
+
+def test_adam_global_bias_correction():
+    """bias_correction='global' drops the per-row t slot and corrects with
+    one shared apply counter (the reference Go Adam's behaviour)."""
+    vocab, dim = 32, 8
+    spec = PackedSpec(vocab, dim)
+    rng = np.random.RandomState(5)
+    table0 = rng.randn(vocab, dim).astype(np.float32)
+    opt = sparse_optim.adam(0.01, bias_correction="global")
+    packed = pk.pack(spec, jnp.asarray(table0))
+    slots = opt.init_slots(spec, packed)
+    assert "t" not in slots and "t_global" in slots
+
+    ids = np.array([3, 3, 9], np.int32)
+    grads = rng.randn(3, dim).astype(np.float32)
+    packed1, slots1 = opt.apply(
+        spec, packed, slots, jnp.asarray(ids), jnp.asarray(grads)
+    )
+    assert float(slots1["t_global"]) == 1.0
+    # First apply: every touched row corrected by 1/(1-beta) exactly like
+    # per-row mode's first touch, so the tables must agree on step 1.
+    per_row = sparse_optim.adam(0.01, bias_correction="per_row")
+    pr_packed1, _ = per_row.apply(
+        spec, packed, per_row.init_slots(spec, packed),
+        jnp.asarray(ids), jnp.asarray(grads),
+    )
+    np.testing.assert_allclose(
+        np.asarray(packed1), np.asarray(pr_packed1), rtol=1e-6, atol=1e-7
+    )
+    # Untouched rows stay bit-identical.
+    np.testing.assert_array_equal(
+        np.asarray(pk.unpack(spec, packed1))[0], table0[0]
+    )
+    # Scatter mode agrees with stream mode under global correction too.
+    sc = sparse_optim.adam(0.01, bias_correction="global", mode="scatter")
+    sc_packed1, sc_slots1 = sc.apply(
+        spec, packed, sc.init_slots(spec, packed),
+        jnp.asarray(ids), jnp.asarray(grads),
+    )
+    np.testing.assert_allclose(
+        np.asarray(sc_packed1), np.asarray(packed1), rtol=1e-5, atol=1e-6
+    )
+    assert float(sc_slots1["t_global"]) == 1.0
+
+
+def test_auto_mode_picks_stream_small_scatter_large():
+    spec_small = PackedSpec(1000, 8)  # num_blocks = 63
+    spec_large = PackedSpec(2_000_000, 8)  # num_blocks = 125k
+    n = 256
+    assert not sparse_optim._use_scatter(spec_small, n, "auto")
+    assert sparse_optim._use_scatter(spec_large, n, "auto")
+    assert sparse_optim._use_scatter(spec_small, n, "scatter")
+    assert not sparse_optim._use_scatter(spec_large, n, "stream")
+    with pytest.raises(ValueError):
+        sparse_optim._use_scatter(spec_small, n, "bogus")
+
+
+def test_scatter_mode_under_jit_and_grad_shapes():
+    """The scatter path must be jittable with static shapes (it runs
+    inside the PS train step's lax.scan window)."""
+    import jax
+
+    spec = PackedSpec(64, 8)
+    opt = sparse_optim.adam(0.01, mode="scatter")
+    table = jnp.asarray(np.random.RandomState(3).randn(64, 8), jnp.float32)
+    packed = pk.pack(spec, table)
+    slots = opt.init_slots(spec, packed)
+
+    @jax.jit
+    def step(packed, slots, ids, grads):
+        return opt.apply(spec, packed, slots, ids, grads)
+
+    ids = jnp.asarray(np.array([1, 1, 5, -1, 70], np.int32))
+    grads = jnp.asarray(np.random.RandomState(4).randn(5, 8), jnp.float32)
+    new_packed, new_slots = step(packed, slots, ids, grads)
+    assert new_packed.shape == packed.shape
+    assert np.isfinite(np.asarray(new_packed)).all()
+    # Row 1 stepped once (duplicates dedup), row 5 once, padding dropped.
+    t = np.asarray(pk.unpack(spec, new_slots["t"]))[:, 0]
+    assert t[1] == 1 and t[5] == 1 and t.sum() == 2

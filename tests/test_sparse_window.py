@@ -1,0 +1,160 @@
+"""Windowed sparse apply (ps_trainer sparse_apply_every > 1).
+
+The relaxation: within a W-step chunk, embedding grads accumulate and the
+sparse optimizer applies ONCE from the sum (forwards read chunk-start
+tables; dense params still update per step) — the async-PS staleness of
+the reference traded for amortizing the streaming moment update (see
+_train_chunk_impl).  These tests pin the plumbing and the exactness cases.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.layers import Embedding
+from elasticdl_tpu.parallel import MeshConfig, build_mesh, sparse_optim
+from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+from tests.test_embedding import DIM, VOCAB, SparseModel, _loss
+
+
+def _batches(k, rng, batch=16):
+    out = []
+    for _ in range(k):
+        ids = rng.randint(0, VOCAB, size=(batch, 3)).astype(np.int32)
+        labels = rng.randint(0, 4, size=batch).astype(np.int32)
+        out.append((ids, labels, np.ones((batch,), np.float32)))
+    return out
+
+
+def _make(sparse_apply_every=1, emb_opt=None, dense_lr=0.1):
+    return ShardedEmbeddingTrainer(
+        SparseModel(), _loss, optax.sgd(dense_lr), build_mesh(MeshConfig()),
+        embedding_optimizer=emb_opt or sparse_optim.adam(0.01),
+        seed=0,
+        sparse_apply_every=sparse_apply_every,
+    )
+
+
+def test_windowed_runs_with_remainder_chunk():
+    """K=7, W=3 -> chunks of 3,3,1; losses come back per step and the step
+    counter advances by K."""
+    rng = np.random.RandomState(0)
+    batches = _batches(7, rng)
+    t = _make(sparse_apply_every=3)
+    t.ensure_initialized(batches[0][0])
+    losses = np.asarray(t.train_window(t.stage_window(batches)))
+    assert losses.shape == (7,)
+    assert np.isfinite(losses).all()
+    assert t.step == 7
+
+
+def test_windowed_first_chunk_first_loss_matches_strict():
+    """Chunk 1 step 1 sees identical state in both modes -> identical loss."""
+    rng = np.random.RandomState(1)
+    batches = _batches(4, rng)
+
+    t_strict = _make(1)
+    t_strict.ensure_initialized(batches[0][0])
+    strict_losses = np.asarray(t_strict.train_window(t_strict.stage_window(batches)))
+
+    t_win = _make(4)
+    t_win.ensure_initialized(batches[0][0])
+    win_losses = np.asarray(t_win.train_window(t_win.stage_window(batches)))
+
+    np.testing.assert_allclose(win_losses[0], strict_losses[0], rtol=1e-6)
+    # Later losses DIFFER (stale tables within the chunk) — that's the
+    # documented trade, not a bug; assert they still train sanely.
+    assert np.isfinite(win_losses).all()
+
+
+class LinearSparseModel(nn.Module):
+    """Output linear in the embedding rows with a CONSTANT readout, so
+    d loss/d row is independent of the table values: strict and windowed
+    training produce bit-equal gradients, making windowed == strict
+    exactly when the sparse optimizer is linear too (SGD)."""
+
+    @nn.compact
+    def __call__(self, ids):
+        x = Embedding(VOCAB, DIM, combiner="sum", name="emb")(ids)
+        return jnp.sum(x, axis=-1, keepdims=True) * jnp.ones((1, 4))
+
+
+def _linear_loss(labels, outputs):
+    # Linear in outputs -> constant gradient.
+    return outputs.mean(axis=-1) * (labels.astype(jnp.float32) * 0 + 1.0)
+
+
+def test_windowed_sgd_linear_model_exact():
+    rng = np.random.RandomState(2)
+    batches = _batches(6, rng)
+
+    def make(w):
+        return ShardedEmbeddingTrainer(
+            LinearSparseModel(), _linear_loss, optax.sgd(0.0),
+            build_mesh(MeshConfig()),
+            embedding_optimizer=sparse_optim.sgd(0.05),
+            seed=0,
+            sparse_apply_every=w,
+        )
+
+    t1 = make(1)
+    t1.ensure_initialized(batches[0][0])
+    np.asarray(t1.train_window(t1.stage_window(batches)))
+
+    t3 = make(3)
+    t3.ensure_initialized(batches[0][0])
+    np.asarray(t3.train_window(t3.stage_window(batches)))
+
+    v1, v3 = t1.get_variables_numpy(), t3.get_variables_numpy()
+    for key in v1:
+        np.testing.assert_allclose(
+            v3[key], v1[key], rtol=1e-6, atol=1e-7, err_msg=key
+        )
+
+
+def test_windowed_checkpoint_state_roundtrips():
+    rng = np.random.RandomState(3)
+    batches = _batches(4, rng)
+    t = _make(2)
+    t.ensure_initialized(batches[0][0])
+    np.asarray(t.train_window(t.stage_window(batches)))
+    state = t.state
+
+    t2 = _make(2)
+    t2.ensure_initialized(batches[0][0])
+    t2.state = state
+    more = _batches(2, rng)
+    losses = np.asarray(t2.train_window(t2.stage_window(more)))
+    assert np.isfinite(losses).all()
+    assert t2.step == 6
+
+
+def test_windowed_single_apply_per_chunk():
+    """The chunk's sparse apply consumes the CONCATENATED (ids, grads) of
+    all W steps through the normal optimizer apply — one moment update
+    per chunk with summed duplicates (== apply_acc of the summed acc, by
+    the dedup contract pinned in test_sparse_optim_modes)."""
+    calls = []
+    base = sparse_optim.adam(0.01)
+
+    def counting_apply(spec, table, slots, ids, grads):
+        calls.append(int(ids.shape[0]))
+        return base.apply(spec, table, slots, ids, grads)
+
+    spy = sparse_optim.SparseOptimizer(
+        base.name, base.init_slots, counting_apply, base.hyperparams,
+        base.apply_acc,
+    )
+    rng = np.random.RandomState(4)
+    batches = _batches(6, rng)
+    t = _make(3, emb_opt=spy)
+    t.ensure_initialized(batches[0][0])
+    np.asarray(t.train_window(t.stage_window(batches)))
+    # 6 steps at W=3 -> 2 chunk applies, each over 3 stacked batches
+    # (16 examples x 3 ids x 3 steps = 144 ids per apply).  Tracing may
+    # record extra entries; the executed structure is what the loss shape
+    # and step counter already pin — here we check each traced apply saw
+    # the 3-step concatenation.
+    assert all(n == 16 * 3 * 3 for n in calls)
