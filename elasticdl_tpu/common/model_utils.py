@@ -24,6 +24,12 @@ class ModelSpec:
     loss: Callable
     optimizer: Callable
     dataset_fn: Callable
+    # Optional vectorized twin of dataset_fn: (columns dict, mode,
+    # metadata) -> (features tree, labels) operating on whole column
+    # arrays.  With a reader exposing read_columns(task), the worker's
+    # task pipeline then never touches individual records
+    # (data/columnar.py — the 1-core-host data plane).
+    columnar_dataset_fn: Optional[Callable] = None
     eval_metrics_fn: Optional[Callable] = None
     callbacks: Optional[Callable] = None
     custom_data_reader: Optional[Callable] = None
@@ -115,6 +121,7 @@ def load_model_spec(args) -> ModelSpec:
         loss=require(args.loss),
         optimizer=require(args.optimizer),
         dataset_fn=require(args.dataset_fn),
+        columnar_dataset_fn=optional("columnar_dataset_fn"),
         eval_metrics_fn=optional(args.eval_metrics_fn),
         callbacks=optional(args.callbacks),
         custom_data_reader=optional(args.custom_data_reader),

@@ -25,6 +25,7 @@ import numpy as np
 from elasticdl_tpu.common.constants import Mode, TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
+from elasticdl_tpu.data.columnar import materialize_columnar_task
 from elasticdl_tpu.data.dataset import Dataset, _stack
 from elasticdl_tpu.parallel import elastic
 from elasticdl_tpu.parallel import sharding as shd
@@ -92,6 +93,7 @@ class CollectiveWorker:
         # back to the already-compiled per-step program instead of
         # compiling a one-off K-step scan per distinct tail size.
         self._effective_window: Optional[int] = None
+        self._columnar_logged = False
         # Task-type -> reader: evaluation/prediction shards address their
         # own data sources when configured.
         self._readers = {
@@ -267,21 +269,56 @@ class CollectiveWorker:
         return list(dataset)
 
     def _local_batches(self, task, mode: str):
-        """Yield (features, labels, mask, global_real) lockstep batches."""
-        records = self._task_records(task, mode)
+        """Yield (features, labels, mask, global_real) lockstep batches.
+
+        Two materializations, one contract: the columnar fast path
+        (data/columnar.py — reader.read_columns + the model's
+        columnar_dataset_fn, batches are row-range VIEWS with zero
+        per-record Python) when both sides support it, else the
+        per-record dataset path."""
+        reader = self._readers.get(task.type, self._readers[pb.TRAINING])
+        columnar = materialize_columnar_task(
+            reader,
+            task,
+            getattr(self._spec, "columnar_dataset_fn", None),
+            mode,
+            self._metadata,
+        )
+        if columnar is not None and not self._columnar_logged:
+            # e2e tests grep this to prove the vectorized path engaged.
+            self._columnar_logged = True
+            logger.info(
+                "Columnar task path engaged (%s, %d rows, zero per-record "
+                "Python)", mode, columnar.n,
+            )
+        records = None if columnar is not None else self._task_records(task, mode)
+
+        def slice_batch(lo_off, hi_off):
+            """(features, labels, n_real) for task-relative rows
+            [lo_off, hi_off); empty slices shape from row 0, all-masked."""
+            if columnar is not None:
+                n_real = max(0, min(hi_off, columnar.n) - lo_off)
+                if n_real:
+                    features, labels = columnar.slice(lo_off, hi_off)
+                else:
+                    features, labels = columnar.slice(0, 1)
+                return features, labels, n_real
+            slice_records = records[lo_off:hi_off]
+            batch = _stack(slice_records if slice_records else records[:1])
+            features, labels = (
+                batch if isinstance(batch, tuple) else (batch, None)
+            )
+            return features, labels, len(slice_records)
+
         for lo, hi, global_real in elastic.iter_local_batch_ranges(
             task.start, task.end, self._mb, self._world
         ):
-            slice_records = records[lo - task.start : hi - task.start]
-            if slice_records:
-                batch = _stack(slice_records)
-            else:
-                # Empty tail slice: shape it from record 0, mask all rows.
-                batch = _stack(records[:1])
-            features, labels = batch if isinstance(batch, tuple) else (batch, None)
+            features, labels, n_real = slice_batch(
+                lo - task.start, hi - task.start
+            )
             features, mask = shd.pad_batch(features, self._block)
-            mask[: len(slice_records)] = 1.0
-            mask[len(slice_records):] = 0.0
+            mask[:n_real] = 1.0
+            mask[n_real:] = 0.0
             if labels is not None:
                 labels, _ = shd.pad_batch(labels, self._block)
             yield features, labels, mask, global_real
