@@ -39,9 +39,18 @@ Methodology (round-2 steadiness fixes, VERDICT weak #1):
 from __future__ import annotations
 
 import json
+import shutil
 import time
 
 import numpy as np
+
+
+def _median_spread(times, work_per_run):
+    """Median rate + min-max relative spread over timed runs (the shared
+    steadiness methodology — see the module docstring)."""
+    rates = sorted(work_per_run / t for t in times)
+    median = rates[len(rates) // 2]
+    return median, (rates[-1] - rates[0]) / median
 
 # Self-established baselines (samples/sec/chip) recorded on the driver's
 # TPU chip; see BASELINE.md. Round 1: 87,639 (column-major tables, sorted
@@ -49,6 +58,15 @@ import numpy as np
 # streaming adam).
 SELF_BASELINE = {
     "deepfm_train_samples_per_sec_per_chip": 87_639.0,
+    # The production data plane, file -> device-ready batches, one host
+    # core (first measured round 3; the coupled e2e number is tracked
+    # with a wide documented spread — tunnel-transfer-bound, BASELINE.md
+    # "End-to-end pipeline" section).
+    "deepfm_e2e_host_pipeline_records_per_sec": 990_000.0,
+    # Tunnel-transfer-bound: observed 165k-330k across runs (H2D weather,
+    # see BASELINE.md) — baseline is the observed midpoint and vs_baseline
+    # swings with the recorded spread, by design.
+    "deepfm_e2e_samples_per_sec_per_chip": 250_000.0,
     # North-star table scale (BASELINE.json: Criteo-1TB rows on chip):
     # vocab 1M x 26 fields = 26M resident rows.  Round-2 measured 192,513
     # samples/s here (the streaming sparse-adam cliff, VERDICT round 2
@@ -129,9 +147,7 @@ def bench_deepfm(
     run_window()  # warmup: compile + first-touch
     run_window()  # second warmup: post-compile caches/power settle
     times = [run_window() for _ in range(repeats)]
-    rates = sorted(batch_size * steps_per_window / t for t in times)
-    median = rates[len(rates) // 2]
-    spread = (rates[-1] - rates[0]) / median
+    median, spread = _median_spread(times, batch_size * steps_per_window)
     n_chips = max(1, len(jax.devices()))
     return median / n_chips, spread
 
@@ -155,6 +171,124 @@ def bench_deepfm_table_scale():
         ),
         sparse_apply_every=16,
     )
+
+
+def _write_criteo_etrf(path: str, n: int, vocab: int, seed: int = 0):
+    """Vectorized ETRF generation (bench fixture, excluded from timing):
+    build the fixed-width record image columnar-side and split to rows."""
+    from elasticdl_tpu.data import recordfile
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    rng = np.random.RandomState(seed)
+    dense = rng.rand(n, zoo.NUM_DENSE).astype(np.float32)
+    cat = rng.randint(0, vocab, size=(n, zoo.NUM_CAT)).astype(np.int32)
+    label = rng.randint(0, 2, size=(n, 1)).astype(np.uint8)
+    buf = np.concatenate(
+        [
+            np.ascontiguousarray(dense).view(np.uint8),
+            np.ascontiguousarray(cat).view(np.uint8),
+            label,
+        ],
+        axis=1,
+    )
+    recordfile.write_records(path, (row.tobytes() for row in buf))
+
+
+def bench_deepfm_e2e(
+    batch_size: int = 8192,
+    vocab: int = 100_000,
+    steps_per_window: int = 96,
+    repeats: int = 3,
+):
+    """The PRODUCTION data-to-device pipeline, timed as one loop: ETRF
+    file -> read_range_buffers -> RecordLayout.parse_buffer ->
+    columnar_dataset_fn (vectorized shuffle) -> row-view batches ->
+    stage_window -> train_window.  Unlike the synthetic benches, every
+    timed window INCLUDES reading + parsing + batch assembly + the
+    host->device transfer — the integrated hot loop of the reference's
+    worker (SURVEY §3.3, †worker/worker.py task loop over †data/reader/).
+    On this harness the transfer rides a tunnel (~25-70 ms/MB, 3x
+    run-to-run — BASELINE.md methodology note), so the coupled number is
+    transfer-bound; BASELINE.md records the host-pipeline-only rate
+    alongside."""
+    import tempfile
+
+    import jax
+
+    from elasticdl_tpu.data.columnar import materialize_columnar_task
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    n = batch_size * steps_per_window
+    tmp = tempfile.mkdtemp(prefix="bench_e2e_")
+    path = f"{tmp}/criteo.etrf"
+    _write_criteo_etrf(path, n, vocab)
+
+    reader = zoo.CriteoRecordReader(path)
+
+    class _Task:
+        start, end = 0, n
+
+    mesh = build_mesh(MeshConfig())
+    trainer = ShardedEmbeddingTrainer(
+        zoo.custom_model(vocab_size=vocab),
+        zoo.loss,
+        zoo.optimizer(),
+        mesh,
+        embedding_optimizer=zoo.embedding_optimizer(),
+    )
+    mask = np.ones((batch_size,), np.float32)
+
+    def host_pipeline():
+        """File -> staged-window-ready batch list (all host work)."""
+        columnar = materialize_columnar_task(
+            reader, _Task, zoo.columnar_dataset_fn, "training", None
+        )
+        return [
+            (*columnar.slice(i * batch_size, (i + 1) * batch_size), mask)
+            for i in range(steps_per_window)
+        ]
+
+    first = host_pipeline()
+    trainer.ensure_initialized(first[0][0])
+
+    def run_epoch(n_windows: int) -> float:
+        """n_windows full passes, ONE completion fence at the end — like
+        the production worker, nothing blocks per window, so host parse
+        of window k+1 overlaps device compute and transfer of window k."""
+        start = time.perf_counter()
+        losses = None
+        for _ in range(n_windows):
+            batches = host_pipeline()
+            window = trainer.stage_window(batches)
+            losses = trainer.train_window(window)
+        host_losses = np.asarray(losses)  # fence (see bench_deepfm)
+        assert np.isfinite(host_losses).all()
+        return time.perf_counter() - start
+
+    # Host pipeline alone (file -> batch views, warm page cache): the
+    # data-plane capacity claim, and stable — unlike the coupled number,
+    # which on this harness is bound by the tunnel's H2D path
+    # (~25-70 ms/MB, 3x run-to-run; production hosts move >10 GB/s over
+    # PCIe so the 129 MB window costs ~13 ms there, not seconds).
+    # Re-warm the page cache: trainer init above evicted it (measured —
+    # without this the first timed pass reads ~2x slow).
+    host_pipeline()
+    host_times = []
+    for _ in range(repeats):
+        start = time.perf_counter()
+        host_pipeline()
+        host_times.append(time.perf_counter() - start)
+    host_median, host_spread = _median_spread(host_times, n)
+
+    run_epoch(1)  # warmup: compile + first-touch
+    run_epoch(1)
+    times = [run_epoch(2) for _ in range(repeats)]
+    median, spread = _median_spread(times, 2 * n)
+    n_chips = max(1, len(jax.devices()))
+    shutil.rmtree(tmp, ignore_errors=True)
+    return (host_median, host_spread), (median / n_chips, spread)
 
 
 def bench_resnet50(
@@ -210,9 +344,7 @@ def bench_resnet50(
     run_window()  # warmup: compile + first-touch
     run_window()  # second warmup: post-compile caches/power settle
     times = [run_window() for _ in range(repeats)]
-    rates = sorted(batch_size * steps_per_window / t for t in times)
-    median = rates[len(rates) // 2]
-    spread = (rates[-1] - rates[0]) / median
+    median, spread = _median_spread(times, batch_size * steps_per_window)
     n_chips = max(1, len(jax.devices()))
     return median / n_chips, spread
 
@@ -268,11 +400,9 @@ def bench_transformer(
     run_window()
     run_window()
     times = [run_window() for _ in range(repeats)]
-    rates = sorted(
-        batch_size * seq_len * steps_per_window / t for t in times
+    median, spread = _median_spread(
+        times, batch_size * seq_len * steps_per_window
     )
-    median = rates[len(rates) // 2]
-    spread = (rates[-1] - rates[0]) / median
     n_chips = max(1, len(jax.devices()))
     return median / n_chips, spread
 
@@ -306,6 +436,19 @@ def main():
         images_per_sec,
         "images/sec/chip",
         r_spread,
+    )
+    (host_rate, h_spread), (e2e_rate, e_spread) = bench_deepfm_e2e()
+    _emit(
+        "deepfm_e2e_host_pipeline_records_per_sec",
+        host_rate,
+        "records/sec/host",
+        h_spread,
+    )
+    _emit(
+        "deepfm_e2e_samples_per_sec_per_chip",
+        e2e_rate,
+        "samples/sec/chip",
+        e_spread,
     )
     table_samples_per_sec, ts_spread = bench_deepfm_table_scale()
     _emit(
