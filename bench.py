@@ -213,6 +213,17 @@ def bench_deepfm_e2e(
     alongside."""
     import tempfile
 
+    n = batch_size * steps_per_window
+    tmp = tempfile.mkdtemp(prefix="bench_e2e_")
+    try:
+        return _bench_deepfm_e2e_body(
+            tmp, n, batch_size, vocab, steps_per_window, repeats
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_deepfm_e2e_body(tmp, n, batch_size, vocab, steps_per_window, repeats):
     import jax
 
     from elasticdl_tpu.data.columnar import materialize_columnar_task
@@ -220,8 +231,6 @@ def bench_deepfm_e2e(
     from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
     from model_zoo.deepfm import deepfm_functional_api as zoo
 
-    n = batch_size * steps_per_window
-    tmp = tempfile.mkdtemp(prefix="bench_e2e_")
     path = f"{tmp}/criteo.etrf"
     _write_criteo_etrf(path, n, vocab)
 
@@ -287,7 +296,6 @@ def bench_deepfm_e2e(
     times = [run_epoch(2) for _ in range(repeats)]
     median, spread = _median_spread(times, 2 * n)
     n_chips = max(1, len(jax.devices()))
-    shutil.rmtree(tmp, ignore_errors=True)
     return (host_median, host_spread), (median / n_chips, spread)
 
 

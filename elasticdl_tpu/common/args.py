@@ -150,6 +150,14 @@ def add_train_arguments(parser: argparse.ArgumentParser):
     )
     parser.add_argument("--task_timeout_s", type=non_neg_int, default=0)
     parser.add_argument(
+        "--jax_compilation_cache_dir", default="",
+        help="Persistent XLA compilation cache directory (shared across "
+        "worker restarts). Elastic recovery restarts the world with fresh "
+        "processes; with the cache, the re-formed world's compiles are "
+        "disk hits instead of recompiles — the dominant recovery cost "
+        "after process start (BASELINE.md elasticity numbers).",
+    )
+    parser.add_argument(
         "--use_bf16", type=str2bool, nargs="?", const=True, default=True,
         help="Compute in bfloat16 on the MXU: forwarded to zoo models "
         "whose custom_model() accepts a use_bf16 parameter (explicit "

@@ -101,6 +101,7 @@ class TaskManager:
         self._task_id = 0
         self._epoch = 0
         self._finished_record_count = 0
+        self._recovered_record_count = 0
         # Aggregated exec counters reported by workers (e.g. batch_count).
         self._exec_counters: Dict[str, int] = {}
         # Tasks dropped after exhausting their retry budget.
@@ -264,6 +265,12 @@ class TaskManager:
                     task_id, task.retry_count, self._max_task_retries,
                 )
                 self._todo.appendleft(task)
+                # Replay accounting: any records this attempt trained
+                # before the error re-train on retry (at-least-once).
+                # TRAINING only — same guard as finished_record_count
+                # (eval/predict replays cost no training records).
+                if task.type == pb.TRAINING:
+                    self._recovered_record_count += task.end - task.start
             if not self._todo and not self._doing and not self._done_callbacks_fired:
                 if self._epoch + 1 >= self._num_epochs or not self._training_shards:
                     self._done_callbacks_fired = True
@@ -297,6 +304,8 @@ class TaskManager:
             for tid in recovered:
                 _owner, task, _start = self._doing.pop(tid)
                 self._todo.appendleft(task)
+                if task.type == pb.TRAINING:
+                    self._recovered_record_count += task.end - task.start
             if recovered:
                 logger.info(
                     "Recovered %d tasks from worker %d", len(recovered), worker_id
@@ -315,6 +324,8 @@ class TaskManager:
         for tid in expired:
             owner, task, _start = self._doing.pop(tid)
             self._todo.appendleft(task)
+            if task.type == pb.TRAINING:
+                self._recovered_record_count += task.end - task.start
             logger.info("Task %d timed out on worker %d; requeued", tid, owner)
 
     # ------------------------------------------------------------------
@@ -355,6 +366,15 @@ class TaskManager:
     def finished_record_count(self) -> int:
         with self._lock:
             return self._finished_record_count
+
+    @property
+    def recovered_record_count(self) -> int:
+        """Records in tasks requeued after worker death/timeout — the
+        at-least-once replay cost of elasticity.  Observability for the
+        recovery-time/lost-work numbers in BASELINE.md (the utilization
+        claim the reference's elasticity pitch implies)."""
+        with self._lock:
+            return self._recovered_record_count
 
     def counts(self) -> Dict[str, int]:
         with self._lock:

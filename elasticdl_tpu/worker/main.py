@@ -30,6 +30,16 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", forced)
     args = parse_worker_args(argv)
+    if getattr(args, "jax_compilation_cache_dir", ""):
+        import jax
+
+        # Persistent compile cache: a re-formed world's jit compiles are
+        # disk hits — the dominant recovery cost after process start.
+        jax.config.update(
+            "jax_compilation_cache_dir", args.jax_compilation_cache_dir
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     model_spec = load_model_spec(args)
     data_reader = build_data_reader(args, model_spec, args.training_data)
     validation_reader = (

@@ -27,12 +27,19 @@ jax.config.update("jax_platforms", "cpu")
 
 def run_kill_recovery_job(
     args, n_records, worker_env, log_dir, progress_fraction=8,
-    wait_timeout=480,
+    wait_timeout=480, recovery_bound_s=240.0,
 ):
     """Shared kill-a-worker elasticity driver (used by the AllReduce and
     context-parallel e2es): start a 2-worker job, wait for real progress,
     SIGKILL the rank-1 worker (restart budget 0), and assert the world
-    shrank to ONE fresh worker while every record still trained."""
+    shrank to ONE fresh worker while every record still trained.
+
+    Quantifies the elasticity claim (BASELINE.md "Elasticity" section):
+    returns {"recovery_s": SIGKILL -> first record finished by the
+    re-formed world (process start + world re-formation + checkpoint
+    restore + compile + first task), "replayed_records": at-least-once
+    replay cost (task ranges requeued from the dead worker)} and asserts
+    recovery under `recovery_bound_s` — the regression tripwire."""
     import time
 
     from elasticdl_tpu.master.main import start_master
@@ -66,7 +73,30 @@ def run_kill_recovery_job(
             time.sleep(0.05)
         victims = manager.current_worker_ids()
         assert len(victims) == 2
+        replayed_before = master.task_manager.recovered_record_count
+        t_kill = time.monotonic()
         manager.kill_worker(victims[1])
+        # Recovery clock: kill -> the re-formed world finishes its first
+        # record.  The count baseline is read only AFTER the relaunch is
+        # visible (fresh worker ids) — the dying world's stragglers can
+        # still report for a few seconds after the SIGKILL, and counting
+        # those as "recovery" would fake a ~0s number.  The re-formed
+        # workers need seconds to boot, far above the 20 ms poll, so the
+        # baseline is race-free in practice.
+        probe_deadline = time.time() + wait_timeout
+        while time.time() < probe_deadline:
+            ids = manager.current_worker_ids()
+            if ids and not set(ids) & set(victims):
+                break  # all-fresh world: relaunch happened
+            time.sleep(0.02)
+        count_at_relaunch = master.task_manager.finished_record_count
+        recovery_s = None
+        while time.time() < probe_deadline:
+            if master.task_manager.finished_record_count > count_at_relaunch:
+                recovery_s = time.monotonic() - t_kill
+                break
+            time.sleep(0.02)
+        assert recovery_s is not None, "no post-kill progress"
         assert manager.wait(timeout=wait_timeout) is True
         assert master.task_manager.finished()
         assert master.task_manager.finished_record_count == n_records
@@ -74,6 +104,24 @@ def run_kill_recovery_job(
         # worker (not the survivor continuing unperturbed).
         assert manager.current_worker_ids() != victims
         assert len(manager.current_worker_ids()) == 1
+        replayed = (
+            master.task_manager.recovered_record_count - replayed_before
+        )
+        # Replay is task-granular (whole ranges requeue; the exact
+        # accounting is unit-tested in test_task_manager) and bounded by
+        # what the dead world could have held in flight.
+        assert replayed % args.records_per_task == 0, replayed
+        assert recovery_s < recovery_bound_s, (
+            f"recovery took {recovery_s:.1f}s (bound {recovery_bound_s}s) — "
+            "the restore path regressed"
+        )
+        metrics = {
+            "recovery_s": recovery_s,
+            "replayed_records": replayed,
+            "records_done_at_relaunch": count_at_relaunch,
+        }
+        print(f"ELASTICITY_METRICS {metrics}", flush=True)
+        return metrics
     finally:
         manager.stop()
         master.stop()

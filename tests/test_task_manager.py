@@ -116,6 +116,37 @@ class TestRecovery:
         assert got == sorted([(t0.start, t0.end), (t1.start, t1.end)])
         assert manager.finished()
 
+    def test_recovered_record_count_accounting(self):
+        """Replay accounting (the elasticity lost-work metric): exact
+        TRAINING ranges of recovered/retried tasks, eval tasks excluded."""
+        manager = TaskManager(
+            training_shards={"x": 30},
+            evaluation_shards={"x": 10},
+            records_per_task=10,
+        )
+        manager.create_evaluation_tasks(0)
+        grabbed = [manager.get(0) for _ in range(4)]  # mixed train + eval
+        n_train = sum(
+            t.end - t.start for t in grabbed if t.type == pb.TRAINING
+        )
+        n_eval = sum(
+            t.end - t.start for t in grabbed if t.type == pb.EVALUATION
+        )
+        assert n_train and n_eval, "fixture must mix task types"
+        assert manager.recovered_record_count == 0
+        assert manager.recover_tasks(0) == 4
+        # Only the TRAINING ranges count as replayed records.
+        assert manager.recovered_record_count == n_train
+
+        # Failed-task retry path counts too (same guard).
+        t = manager.get(1)
+        while t is not None and t.type != pb.TRAINING:
+            manager.report(t.task_id, True, 1)
+            t = manager.get(1)
+        before = manager.recovered_record_count
+        manager.report(t.task_id, False, 1)
+        assert manager.recovered_record_count == before + (t.end - t.start)
+
     def test_task_timeout_recovery(self):
         manager = TaskManager(
             training_shards={"x": 10}, records_per_task=10, task_timeout_s=0.001
