@@ -115,9 +115,10 @@ def add_train_arguments(parser: argparse.ArgumentParser):
     parser.add_argument(
         "--train_window_steps", type=non_neg_int, default=0,
         help="Training batches fused per device dispatch in cluster "
-        "strategies (0 = framework default of 8). Larger windows amortize "
-        "per-dispatch host latency (see BASELINE.md) at the cost of "
-        "staged-batch memory and checkpoint granularity.",
+        "strategies. 0 = AUTO: up to 400 steps (the measured optimum, "
+        "BASELINE.md dispatch-window scaling), bounded by the task's "
+        "batch count and a 1 GiB staged-bytes cap. Explicit values "
+        "override the auto sizing entirely.",
     )
     parser.add_argument(
         "--sparse_apply_every", type=pos_int, default=1,

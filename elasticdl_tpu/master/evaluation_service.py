@@ -36,8 +36,24 @@ class EvaluationService:
         self._last_eval_version = -1
         # Per in-flight round (keyed by model_version):
         self._reported: Dict[int, List] = {}   # list of (outputs dict, labels)
-        self._expected_reports: Dict[int, int] = {}
-        self._report_counts: Dict[int, int] = {}
+        # Chunked reports STAGE per (model_version, task_id) and promote
+        # into the round only when that task COMPLETES: task ids are
+        # fresh per attempt, so a failed/timed-out attempt's partial
+        # chunks are simply never promoted (no double-counted rows on
+        # at-least-once retry).
+        self._staged: Dict[tuple, List] = {}
+        # A round finalizes when all its EVALUATION tasks COMPLETE (task-
+        # manager callback) — NOT when a report count is reached: workers
+        # flush several chunked metric reports per task (the eval-memory
+        # bound, collective_worker.EVAL_REPORT_BATCHES), and each task's
+        # chunks all precede its completion report on the worker's
+        # synchronous gRPC channel.
+        self._expected_tasks: Dict[int, int] = {}
+        self._completed_tasks: Dict[int, int] = {}
+        if task_manager is not None and hasattr(
+            task_manager, "add_eval_task_done_callback"
+        ):
+            task_manager.add_eval_task_done_callback(self._on_eval_task_done)
         # Rounds already finalized: late/duplicate reports (possible under
         # at-least-once task retry) are dropped, not resurrected.
         self._finalized_versions: set = set()
@@ -64,15 +80,17 @@ class EvaluationService:
         count = self._task_manager.create_evaluation_tasks(model_version)
         with self._lock:
             if count > 0:
-                self._expected_reports[model_version] = (
-                    self._expected_reports.get(model_version, 0) + count
+                self._expected_tasks[model_version] = (
+                    self._expected_tasks.get(model_version, 0) + count
                 )
 
     # ------------------------------------------------------------------
     # Aggregation
     # ------------------------------------------------------------------
 
-    def report_evaluation_metrics(self, model_version, model_outputs_pb, labels_pb):
+    def report_evaluation_metrics(
+        self, model_version, model_outputs_pb, labels_pb, task_id: int = 0
+    ):
         outputs = {
             tensor.name or "output": tensor_utils.pb_to_ndarray(tensor)
             for tensor in model_outputs_pb
@@ -88,14 +106,28 @@ class EvaluationService:
                     model_version,
                 )
                 return
-            self._reported.setdefault(model_version, []).append((outputs, labels))
-            self._report_counts[model_version] = (
-                self._report_counts.get(model_version, 0) + 1
+            self._staged.setdefault((model_version, task_id), []).append(
+                (outputs, labels)
             )
-            expected = self._expected_reports.get(model_version)
+
+    def _on_eval_task_done(self, model_version: int, task_id: int):
+        """Task-manager callback: an EVALUATION task of this round
+        completed (its chunked reports have all arrived — worker RPC
+        ordering).  Promote ITS staged chunks (a dead attempt's chunks
+        stay behind under their stale task id) and finalize once every
+        task of the round is in."""
+        with self._lock:
+            if model_version in self._finalized_versions:
+                return
+            chunks = self._staged.pop((model_version, task_id), [])
+            self._reported.setdefault(model_version, []).extend(chunks)
+            self._completed_tasks[model_version] = (
+                self._completed_tasks.get(model_version, 0) + 1
+            )
+            expected = self._expected_tasks.get(model_version)
             complete = (
                 expected is not None
-                and self._report_counts[model_version] >= expected
+                and self._completed_tasks[model_version] >= expected
             )
         if complete:
             self._finalize_round(model_version)
@@ -113,8 +145,11 @@ class EvaluationService:
             return {}
         with self._lock:
             batches = self._reported.pop(model_version, [])
-            self._report_counts.pop(model_version, None)
-            self._expected_reports.pop(model_version, None)
+            self._completed_tasks.pop(model_version, None)
+            self._expected_tasks.pop(model_version, None)
+            # Purge orphaned staged chunks (dead attempts of this round).
+            for key in [k for k in self._staged if k[0] == model_version]:
+                del self._staged[key]
             self._finalized_versions.add(model_version)
         if not batches:
             return {}

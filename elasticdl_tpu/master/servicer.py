@@ -70,6 +70,7 @@ class MasterServicer(_Base):
                 request.model_version,
                 list(request.model_outputs),
                 list(request.labels),
+                task_id=request.task_id,
             )
         return pb.ReportEvaluationMetricsResponse()
 

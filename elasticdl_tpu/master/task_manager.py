@@ -113,6 +113,7 @@ class TaskManager:
         # they finish, or a second worker could exit before those tasks land.
         self._finalizing = False
         self._epoch_done_callbacks: List[Callable[[int], None]] = []
+        self._eval_task_done_callbacks: List[Callable[[int, int], None]] = []
 
         if self._training_shards:
             self._create_training_tasks_locked()
@@ -246,9 +247,12 @@ class TaskManager:
                 logger.warning("Report for unknown/expired task %d", task_id)
                 return False
             owner, task, _start = entry
+            eval_done_cbs = []
             if success:
                 if task.type == pb.TRAINING:
                     self._finished_record_count += task.end - task.start
+                if task.type == pb.EVALUATION:
+                    eval_done_cbs = list(self._eval_task_done_callbacks)
                 for key, value in (exec_counters or {}).items():
                     self._exec_counters[key] = self._exec_counters.get(key, 0) + value
             elif task.retry_count + 1 > self._max_task_retries:
@@ -277,6 +281,13 @@ class TaskManager:
                     self._finalizing = True
                     fired_done = True
                     callbacks_to_run = list(self._tasks_done_callbacks)
+        # Outside the lock: eval-done first (round finalization must see
+        # the completed task before any job-level done callbacks run).
+        for cb in eval_done_cbs:
+            try:
+                cb(task.model_version, task_id)
+            except Exception:
+                logger.exception("eval-task-done callback failed")
         if fired_done:
             self._run_done_callbacks(callbacks_to_run)
         return True
@@ -335,6 +346,18 @@ class TaskManager:
     def add_tasks_done_callback(self, callback: Callable[[], None]):
         with self._lock:
             self._tasks_done_callbacks.append(callback)
+
+    def add_eval_task_done_callback(
+        self, callback: Callable[[int, int], None]
+    ):
+        """Called (outside the lock) with (model_version, task_id) each
+        time an EVALUATION task completes successfully — the evaluation
+        service finalizes a round on TASK completions, not on metric
+        report counts (workers may flush several chunked reports per
+        task; see collective_worker.EVAL_REPORT_BATCHES), and promotes
+        that task's staged chunks."""
+        with self._lock:
+            self._eval_task_done_callbacks.append(callback)
 
     def add_epoch_done_callback(self, callback: Callable[[int], None]):
         """Called (outside the lock) each time a training epoch completes

@@ -1,9 +1,10 @@
 """Pallas TPU flash-attention kernel (forward + backward).
 
-The hot op of the long-context path on a single chip (the cross-chip
-ring in parallel/ring_attention.py currently uses its own XLA block
-math — fusing this kernel into the ring steps would require exposing
-the m/l accumulators and is future work).  A hand-scheduled Pallas
+The hot op of the long-context path — single-chip (`flash_attention`)
+AND per-ring-step inside the cross-chip ring (`flash_ring_step` /
+`flash_ring_step_bwd`, consumed by parallel/ring_attention's pallas
+impl; measured 1.25x-3x over the ring's XLA block math as T_local grows
+2048 -> 16384, BASELINE.md).  A hand-scheduled Pallas
 kernel instead of the XLA-fused blockwise einsum
 because attention's online-softmax recurrence is exactly the pattern XLA
 can't restructure itself: the [T, T] score slab must never exist, scores
@@ -317,6 +318,310 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
         ],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# ring-step kernels (parallel/ring_attention.py's per-step engine)
+#
+# Same math as the kernels above with two ring-specific twists:
+# - causal masking uses EXPLICIT position arrays (q_pos [Tq], k_pos [Tk])
+#   instead of block-index arithmetic — a rotating KV block's global
+#   positions depend on its source shard, and the zigzag layout's are not
+#   even affine;
+# - the forward RETURNS (out_i, lse_i) unnormalized-combinable partials:
+#   the ring recombines steps exactly via
+#       lse = logaddexp(lse_c, lse_i)
+#       acc = acc * exp(lse_c - lse) + out_i * exp(lse_i - lse)
+#   so no m/l state ever crosses the kernel boundary (a fully-masked
+#   step's lse_i = NEG_INF contributes exp(-inf) = 0 automatically).
+# The backward reuses the flash identity P = exp(S - lse_final): each
+# ring step's (dq contribution, dk/dv of the rotating block) needs only
+# the FINAL lse + delta, so the step kernels stay stateless.
+# ----------------------------------------------------------------------
+
+
+def _fwd_ring_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref,
+                     lse_ref, *, scale, causal, block_k):
+    """One q-block vs the ring step's whole KV block; emits the UNscaled
+    partial (out_i normalized by its own l_i, plus lse_i)."""
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
+    t_k = k_ref.shape[2]
+    n_k = t_k // block_k
+    block_q = q.shape[0]
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qpos_ref[0, 0]  # [block_q, 1]
+            k_pos = kpos_ref[0, 0, :, pl.ds(j * block_k, block_k)]  # [1, block_k]
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        # Fully-masked-so-far rows: keep the exp argument finite.
+        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - safe_m)
+        if causal:
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        correction = jnp.where(
+            m <= NEG_INF / 2, 0.0, jnp.exp(m - safe_m)
+        )
+        l_new = l * correction + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * correction + pv
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    # lse of an untouched row is NEG_INF (drops out of the combine).
+    lse_ref[0, 0] = jnp.where(
+        l == 0.0, NEG_INF, jnp.where(m <= NEG_INF / 2, 0.0, m) + jnp.log(l_safe)
+    )
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct that inherits `like`'s varying-mesh-axes type —
+    required when these kernels run inside shard_map (the ring), where
+    check_vma demands explicit output vma."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _match_vma(x, like):
+    """Give `x` at least `like`'s varying-mesh-axes type (shard_map's
+    check_vma requires all kernel operands to agree; position arrays are
+    only `model`-varying while q varies over the data axis too)."""
+    want = getattr(jax.typeof(like), "vma", None)
+    if not want:
+        return x
+    have = getattr(jax.typeof(x), "vma", None) or frozenset()
+    missing = tuple(set(want) - set(have))
+    return jax.lax.pvary(x, missing) if missing else x
+
+
+def flash_ring_step(q, k_blk, v_blk, q_pos, k_pos, *, causal, scale,
+                    block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK,
+                    interpret=None):
+    """One ring step's partial attention.  q [B,H,Tq,D] (kernel layout),
+    k/v [B,H,Tk,D], positions int32 [Tq]/[Tk].  Returns (out_i
+    [B,H,Tq,D] f32, lse_i [B,H,Tq,1] f32)."""
+    b, h, tq, d = q.shape
+    tk = k_blk.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"ring-step kernel needs block-divisible shard lengths; got "
+            f"Tq={tq} (block {block_q}), Tk={tk} (block {block_k}) — the "
+            "truncating grid would silently drop tail rows"
+        )
+    interpret = _use_interpret() if interpret is None else interpret
+    qp = _match_vma(q_pos.astype(jnp.int32).reshape(1, 1, tq, 1), q)
+    kp = _match_vma(k_pos.astype(jnp.int32).reshape(1, 1, 1, tk), q)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_ring_kernel, scale=scale, causal=causal, block_k=block_k
+        ),
+        grid=(b, h, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (0, 0, i, 0)),
+            pl.BlockSpec((1, 1, 1, tk), lambda b, h, i: (0, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            _out_struct((b, h, tq, d), jnp.float32, q),
+            _out_struct((b, h, tq, 1), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(q, k_blk, v_blk, qp, kp)
+    return out, lse
+
+
+def _dq_ring_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    qpos_ref, kpos_ref, dq_ref, *,
+                    scale, causal, block_k):
+    """dq contribution of ONE ring step's KV block (grid over q-blocks,
+    inner fori over this block's KV): P = exp(S - lse_final)."""
+    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, D]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # [block_q, 1]
+    delta = delta_ref[0, 0]
+    t_k = k_ref.shape[2]
+    n_k = t_k // block_k
+
+    def body(j, acc):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        s = jax.lax.dot_general(
+            q * scale, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qpos_ref[0, 0]  # [block_q, 1]
+            k_pos = kpos_ref[0, 0, :, pl.ds(j * block_k, block_k)]  # [1, block_k]
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    acc0 = jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
+    dq_ref[0, 0] = (
+        jax.lax.fori_loop(0, n_k, body, acc0) * scale
+    ).astype(dq_ref.dtype)
+
+
+def _dkv_ring_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     qpos_ref, kpos_ref, dk_ref, dv_ref,
+                     *, scale, causal, block_q):
+    """dk/dv of ONE ring step's KV block vs the local q shard (grid over
+    k-blocks, inner fori over q-blocks)."""
+    k_blk = k_ref[0, 0].astype(jnp.float32)  # [block_kk, D]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    t_q = q_ref.shape[2]
+    n_q = t_q // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32
+        )
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0][None, :]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0][None, :]
+        s_t = jax.lax.dot_general(
+            k_blk, q * scale, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_kk, block_q]
+        if causal:
+            q_pos = qpos_ref[0, 0, :, pl.ds(i * block_q, block_q)]  # [1, block_q]
+            k_pos = kpos_ref[0, 0]  # [block_kk, 1]
+            s_t = jnp.where(k_pos > q_pos, NEG_INF, s_t)
+        p_t = jnp.exp(s_t - lse)
+        dv = dv + jax.lax.dot_general(
+            p_t, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_t = jax.lax.dot_general(
+            v_blk, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - delta)
+        dk = dk + jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    z = jnp.zeros((k_blk.shape[0], k_blk.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, n_q, body, (z, z))
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def flash_ring_step_bwd(q, k_blk, v_blk, do, lse, delta, q_pos, k_pos, *,
+                        causal, scale, block_q=DEFAULT_BLOCK,
+                        block_k=DEFAULT_BLOCK, interpret=None):
+    """One ring step's backward: (dq contribution [B,H,Tq,D] f32,
+    dk [B,H,Tk,D] f32, dv [B,H,Tk,D] f32).  `lse`/`delta` are the FINAL
+    ring-combined stats [B,H,Tq,1]."""
+    b, h, tq, d = q.shape
+    tk = k_blk.shape[2]
+    block_q_ = min(block_q, tq)
+    block_k_ = min(block_k, tk)
+    if tq % block_q_ or tk % block_k_:
+        raise ValueError(
+            f"ring-step backward needs block-divisible shard lengths; got "
+            f"Tq={tq} (block {block_q_}), Tk={tk} (block {block_k_})"
+        )
+    interpret = _use_interpret() if interpret is None else interpret
+    qp = _match_vma(q_pos.astype(jnp.int32).reshape(1, 1, tq, 1), q)
+    kp_lanes = _match_vma(k_pos.astype(jnp.int32).reshape(1, 1, 1, tk), q)
+    qp_lanes = _match_vma(q_pos.astype(jnp.int32).reshape(1, 1, 1, tq), q)
+    kp = _match_vma(k_pos.astype(jnp.int32).reshape(1, 1, tk, 1), q)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_ring_kernel, scale=scale, causal=causal, block_k=block_k_
+        ),
+        grid=(b, h, tq // block_q_),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q_, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_q_, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q_, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q_, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q_, 1), lambda b, h, i: (0, 0, i, 0)),
+            pl.BlockSpec((1, 1, 1, tk), lambda b, h, i: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q_, d), lambda b, h, i: (b, h, i, 0)
+        ),
+        out_shape=_out_struct((b, h, tq, d), jnp.float32, q),
+        interpret=interpret,
+    )(q, k_blk, v_blk, do, lse, delta, qp, kp_lanes)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_ring_kernel, scale=scale, causal=causal, block_q=block_q_
+        ),
+        grid=(b, h, tk // block_k_),
+        in_specs=[
+            pl.BlockSpec((1, 1, tq, d), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k_, d), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k_, d), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, tq, d), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, tq, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, tq, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, tq), lambda b, h, j: (0, 0, 0, 0)),
+            pl.BlockSpec((1, 1, block_k_, 1), lambda b, h, j: (0, 0, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_k_, d), lambda b, h, j: (b, h, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k_, d), lambda b, h, j: (b, h, j, 0)
+            ),
+        ],
+        out_shape=[
+            _out_struct((b, h, tk, d), jnp.float32, k_blk),
+            _out_struct((b, h, tk, d), jnp.float32, k_blk),
+        ],
+        interpret=interpret,
+    )(q, k_blk, v_blk, do, lse, delta, qp_lanes, kp)
     return dq, dk, dv
 
 

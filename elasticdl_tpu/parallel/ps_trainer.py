@@ -589,6 +589,11 @@ class ShardedEmbeddingTrainer:
         return jax.tree.map(lambda x: np.asarray(x)[:n], outputs)
 
     def eval_step_local(self, features):
+        # The gather is ONE GLOBAL BATCH of outputs to every host (a
+        # collective, so all ranks call it) — memory is batch-bounded;
+        # task/dataset-scale bounding lives in the worker's streaming
+        # eval loop (collective_worker EVAL_REPORT_BATCHES +
+        # data/dataset.SequentialRecords).
         state = self.ensure_initialized(features)
         features = shd.assemble_global_batch(features, self._mesh)
         outputs = self._eval_step(state, features)

@@ -26,6 +26,7 @@ Shapes follow the JAX convention [batch, seq, heads, head_dim].
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Optional
 
@@ -271,23 +272,203 @@ def ring_attention(
     return _finalize(m, l, acc, q.dtype)
 
 
+def _to_kernel(x):  # [B, T, H, D] -> [B, H, T, D]
+    return x.transpose(0, 2, 1, 3)
+
+
+def _ring_axis_geometry(cfg, tq, tk):
+    """(axis_size, my_index, q_pos, perm) — recomputed inside EVERY side
+    of the custom VJP below: closing over these (they are tracers under
+    shard_map) leaks tracers across the custom_vjp boundary when the
+    ring runs under jit+scan."""
+    axis_name, causal, scale, layout, interpret = cfg
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    q_pos = _shard_positions(my_index, tq, axis_size, layout)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return axis_size, my_index, q_pos, perm
+
+
+def _ring_pallas_forward(cfg, q, k, v):
+    """Forward ring: each step runs the flash kernel on the rotating KV
+    block and steps recombine exactly in lse space (a fully-masked
+    step's lse_i = NEG_INF contributes exp(-inf) = 0)."""
+    from elasticdl_tpu.ops.flash_attention import flash_ring_step
+
+    axis_name, causal, scale, layout, interpret = cfg
+    tq, tk = q.shape[1], k.shape[1]
+    axis_size, my_index, q_pos, perm = _ring_axis_geometry(cfg, tq, tk)
+    qk = _to_kernel(q)
+    acc0 = jnp.zeros_like(qk, jnp.float32)
+    lse0 = jnp.full(qk.shape[:3] + (1,), NEG_INF, jnp.float32) + (
+        0.0 * qk[..., :1].astype(jnp.float32)
+    )  # inherit q's varying mesh axes (shard_map typed-axes rule)
+
+    def body(carry, step):
+        acc, lse_c, k_blk, v_blk = carry
+        src = (my_index - step) % axis_size
+        k_pos = _shard_positions(src, tk, axis_size, layout)
+        o_i, lse_i = flash_ring_step(
+            qk, _to_kernel(k_blk), _to_kernel(v_blk), q_pos, k_pos,
+            causal=causal, scale=scale, interpret=interpret,
+        )
+        lse_new = jnp.logaddexp(lse_c, lse_i)
+        safe = jnp.where(lse_new <= NEG_INF / 2, 0.0, lse_new)
+        acc = (
+            acc * jnp.exp(jnp.where(lse_c <= NEG_INF / 2, NEG_INF, lse_c) - safe)
+            + o_i * jnp.exp(jnp.where(lse_i <= NEG_INF / 2, NEG_INF, lse_i) - safe)
+        )
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (acc, lse_new, k_blk, v_blk), None
+
+    (acc, lse, _, _), _ = jax.lax.scan(
+        body, (acc0, lse0, k, v), jnp.arange(axis_size)
+    )
+    out = _to_kernel(acc).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ring_pallas(cfg, q, k, v):
+    """Pallas-engined ring attention core (per-shard; under shard_map).
+    `cfg` = (axis_name, causal, scale, layout, interpret), all static.
+    The round-2 'fuse the kernel into the ring' gap (VERDICT #3)."""
+    return _ring_pallas_forward(cfg, q, k, v)[0]
+
+
+def _ring_pallas_fwd(cfg, q, k, v):
+    out, lse = _ring_pallas_forward(cfg, q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_pallas_bwd(cfg, res, g):
+    """Ring-aware backward: re-rotate KV (and the dk/dv accumulators
+    with them) for axis_size steps; every step reuses the flash backward
+    identity P = exp(S - lse_final) via stateless step kernels, so after
+    the full rotation each KV block's gradient arrives home."""
+    from elasticdl_tpu.ops.flash_attention import flash_ring_step_bwd
+
+    axis_name, causal, scale, layout, interpret = cfg
+    q, k, v, out, lse = res
+    tq, tk = q.shape[1], k.shape[1]
+    axis_size, my_index, q_pos, perm = _ring_axis_geometry(cfg, tq, tk)
+    qk = _to_kernel(q)
+    do = _to_kernel(g).astype(jnp.float32)
+    outk = _to_kernel(out).astype(jnp.float32)
+    delta = jnp.sum(do * outk, axis=-1, keepdims=True)  # [B,H,Tq,1]
+    dq0 = jnp.zeros_like(qk, jnp.float32)
+    dk0 = jnp.zeros_like(_to_kernel(k), jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+
+    def body(carry, step):
+        dq_acc, k_blk, v_blk, dk_blk, dv_blk = carry
+        src = (my_index - step) % axis_size
+        k_pos = _shard_positions(src, tk, axis_size, layout)
+        dq_i, dk_i, dv_i = flash_ring_step_bwd(
+            qk, _to_kernel(k_blk), _to_kernel(v_blk), do, lse, delta,
+            q_pos, k_pos, causal=causal, scale=scale,
+            interpret=interpret,
+        )
+        dq_acc = dq_acc + dq_i
+        dk_blk = dk_blk + dk_i
+        dv_blk = dv_blk + dv_i
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+        return (dq_acc, k_blk, v_blk, dk_blk, dv_blk), None
+
+    (dq_acc, _, _, dk_acc, dv_acc), _ = jax.lax.scan(
+        body, (dq0, k, v, dk0, dv0), jnp.arange(axis_size)
+    )
+    return (
+        _to_kernel(dq_acc).astype(q.dtype),
+        _to_kernel(dk_acc).astype(k.dtype),
+        _to_kernel(dv_acc).astype(v.dtype),
+    )
+
+
+_ring_pallas.defvjp(_ring_pallas_fwd, _ring_pallas_bwd)
+
+
+def ring_attention_pallas(
+    q, k, v, *, axis_name, causal=False, scale=None,
+    layout="contiguous", interpret=None,
+):
+    """Ring attention with the Pallas flash kernel as the per-step block
+    engine.  Same contract as `ring_attention` (call under shard_map,
+    local [B, T_local, H, D] shards); `interpret=None` auto-selects
+    interpret mode off-TPU."""
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    from elasticdl_tpu.ops.flash_attention import _use_interpret
+
+    interpret = _use_interpret() if interpret is None else interpret
+    scale_ = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _ring_pallas(
+        (axis_name, causal, scale_, layout, interpret), q, k, v
+    )
+
+
+def _ring_dispatch(q, k, v, *, axis_name, causal, scale=None,
+                   layout="contiguous", impl="auto"):
+    """Per-shard impl selection (shapes are static at trace time):
+    'pallas' = flash kernels per ring step (2.4x the XLA block engine on
+    the chip, BASELINE.md), 'xla' = the blockwise einsum engine, 'auto' =
+    pallas whenever the kernel supports the local shard shape."""
+    if impl == "auto":
+        from elasticdl_tpu.ops.flash_attention import supports
+
+        t, d = q.shape[1], q.shape[3]
+        impl = (
+            "pallas"
+            if supports(t, d) and supports(k.shape[1], d)
+            else "xla"
+        )
+    if impl == "pallas":
+        return ring_attention_pallas(
+            q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+            layout=layout,
+        )
+    if impl != "xla":
+        raise ValueError(f"impl must be auto|pallas|xla, got {impl!r}")
+    return ring_attention(
+        q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+        layout=layout,
+    )
+
+
 def make_ring_attention(mesh, *, axis: str = MODEL_AXIS,
-                        causal: bool = False, layout: str = "contiguous"):
+                        causal: bool = False, layout: str = "contiguous",
+                        impl: str = "auto"):
     """Build the shard_mapped ring-attention callable for `mesh`: batch
     sharded over `data`, sequence over `axis`.  The ONE place the
     sharding specs live — both ring_self_attention and mesh-aware models
     (model_zoo/transformer) call this.  With `layout="zigzag"` the
     caller is responsible for feeding sequences permuted by
-    `zigzag_order` (and un-permuting outputs with `inverse_order`)."""
+    `zigzag_order` (and un-permuting outputs with `inverse_order`).
+    `impl` selects the per-step block engine (see _ring_dispatch)."""
     spec = P(DATA_AXIS, axis, None, None)
-    return _shard_map()(
-        partial(
-            ring_attention, axis_name=axis, causal=causal, layout=layout
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    fn = partial(
+        _ring_dispatch, axis_name=axis, causal=causal, layout=layout,
+        impl=impl,
     )
+    sm = _shard_map()
+    if impl == "xla":
+        # Keep shard_map's varying-axes checking on the pure-XLA engine.
+        return sm(fn, **kwargs)
+    # check_vma off only where the pallas engine can be selected: kernel
+    # interpret mode (CPU tests/dryruns) trips a jax limitation inside
+    # the kernel interpreter ("Primitive dynamic_slice requires varying
+    # manual axes to match ... as a temporary workaround pass
+    # check_vma=False"); collective placement is pinned by the
+    # parity+HLO-structure tests instead.
+    try:
+        return sm(fn, check_vma=False, **kwargs)
+    except TypeError:  # older jax: the flag was called check_rep
+        return sm(fn, check_rep=False, **kwargs)
 
 
 def ring_self_attention(
@@ -299,6 +480,7 @@ def ring_self_attention(
     axis: str = MODEL_AXIS,
     causal: bool = False,
     layout: str = "contiguous",
+    impl: str = "auto",
 ):
     """Host-level entry: global [B, T, H, D] arrays in, attention out,
     computed ring-wise with batch sharded over `data` and sequence over
@@ -309,7 +491,9 @@ def ring_self_attention(
     in natural sequence order, the balanced layout is internal."""
     k = q if k is None else k
     v = q if v is None else v
-    fn = make_ring_attention(mesh, axis=axis, causal=causal, layout=layout)
+    fn = make_ring_attention(
+        mesh, axis=axis, causal=causal, layout=layout, impl=impl
+    )
     sharding = NamedSharding(mesh, P(DATA_AXIS, axis, None, None))
     if layout == "zigzag":
         if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:

@@ -40,11 +40,14 @@ class MasterClient:
                 request.exec_counters[key] = int(value)
         self._stub.report_task_result(request)
 
-    def report_evaluation_metrics(self, model_version: int, model_outputs, labels):
+    def report_evaluation_metrics(self, model_version: int, model_outputs,
+                                  labels, task_id: int = 0):
         """`model_outputs` is {name: array}; `labels` is an array or a
-        {name: array} dict (multi-label models)."""
+        {name: array} dict (multi-label models).  `task_id` scopes the
+        chunked reports to their EVALUATION task (see the proto note)."""
         request = pb.ReportEvaluationMetricsRequest(
-            worker_id=self._worker_id, model_version=model_version
+            worker_id=self._worker_id, model_version=model_version,
+            task_id=task_id,
         )
         for name, array in model_outputs.items():
             request.model_outputs.append(tensor_utils.ndarray_to_pb(array, name=name))

@@ -80,3 +80,64 @@ class TestRecordFile:
         open(path, "wb").write(b"not a record file at all, definitely")
         with pytest.raises(recordfile.RecordFileError):
             recordfile.count_records(path)
+
+
+class TestSequentialRecords:
+    """The eval-memory bound: records stream one-pass, never a full-task
+    list (VERDICT round-2 weak #5)."""
+
+    def _counting_dataset(self, n):
+        from elasticdl_tpu.data.dataset import Dataset
+
+        consumed = []
+
+        def gen():
+            for i in range(n):
+                consumed.append(i)
+                yield ({"x": np.full((2,), i, np.float32)}, np.int32(i))
+
+        return Dataset.from_generator(gen), consumed
+
+    def test_slices_match_list_semantics(self):
+        from elasticdl_tpu.data.dataset import SequentialRecords
+
+        ds, _ = self._counting_dataset(10)
+        labels = [int(r[1]) for r in list(ds)]
+        cur = SequentialRecords(ds)
+
+        def got(lo, hi):
+            return [int(r[1]) for r in cur.slice(lo, hi)]
+
+        assert got(0, 3) == labels[0:3]
+        assert got(5, 8) == labels[5:8]  # skip [3,5)
+        assert got(8, 20) == labels[8:10]  # past end truncates
+        assert got(20, 25) == []
+
+    def test_streaming_consumes_only_what_is_needed(self):
+        from elasticdl_tpu.data.dataset import SequentialRecords
+
+        ds, consumed = self._counting_dataset(1000)
+        cur = SequentialRecords(ds)
+        cur.slice(0, 4)
+        assert len(consumed) == 4, "cursor must not materialize the task"
+
+    def test_one_pass_rewind_rejected(self):
+        from elasticdl_tpu.data.dataset import SequentialRecords
+
+        ds, _ = self._counting_dataset(10)
+        cur = SequentialRecords(ds)
+        cur.slice(0, 5)
+        with pytest.raises(ValueError, match="one-pass"):
+            cur.slice(2, 4)
+
+    def test_template_peek_then_slice_includes_record_zero(self):
+        from elasticdl_tpu.data.dataset import SequentialRecords
+
+        ds, _ = self._counting_dataset(5)
+        labels = [int(r[1]) for r in list(ds)]
+        cur = SequentialRecords(ds)
+        assert int(cur.template()[1]) == labels[0]  # peek does not consume
+        assert [int(r[1]) for r in cur.slice(0, 2)] == labels[0:2]
+        # Template stays available after exhaustion (ragged-tail shaping).
+        assert [int(r[1]) for r in cur.slice(2, 99)] == labels[2:5]
+        assert int(cur.template()[1]) == labels[0]

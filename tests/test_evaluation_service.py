@@ -19,11 +19,12 @@ def metrics_fn():
     }
 
 
-def report(service, version, outputs, labels):
+def report(service, version, outputs, labels, task_id=0):
     service.report_evaluation_metrics(
         version,
         [tensor_utils.ndarray_to_pb(np.asarray(outputs), name="output")],
         [tensor_utils.ndarray_to_pb(np.asarray(labels))],
+        task_id=task_id,
     )
 
 
@@ -36,29 +37,71 @@ def make_service(eval_records=20, records_per_task=10):
     return EvaluationService(manager, eval_metrics_fn=metrics_fn), manager
 
 
+def _eval_tasks(manager, n):
+    """Pull the round's EVALUATION tasks (they interleave at the front)."""
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+    tasks = [manager.get(0) for _ in range(n)]
+    assert all(t.type == pb.EVALUATION for t in tasks)
+    return tasks
+
+
 def test_round_aggregates_all_reports():
-    service, _ = make_service()  # 2 eval tasks expected per round
+    """Rounds finalize on TASK completions, and a task may flush SEVERAL
+    chunked metric reports before completing (the worker's eval-memory
+    bound) — all chunks must aggregate."""
+    service, manager = make_service()  # 2 eval tasks per round
     service.trigger_evaluation(model_version=3)
-    out1 = np.array([[0.9, 0.1], [0.2, 0.8]])
-    out2 = np.array([[0.7, 0.3]])
-    report(service, 3, out1, np.array([0, 1]))
+    t1, t2 = _eval_tasks(manager, 2)
+    # Task 1 flushes two chunks, then completes.
+    report(service, 3, np.array([[0.9, 0.1]]), np.array([0]), t1.task_id)
+    report(service, 3, np.array([[0.2, 0.8]]), np.array([1]), t1.task_id)
+    manager.report(t1.task_id, True, 0)
     assert service.latest_metrics == {}  # round not complete yet
-    report(service, 3, out2, np.array([1]))
+    report(service, 3, np.array([[0.7, 0.3]]), np.array([1]), t2.task_id)
+    manager.report(t2.task_id, True, 0)
     assert service.latest_metrics == {"accuracy": 2.0 / 3.0}
 
 
 def test_duplicate_report_after_finalize_is_dropped():
-    """At-least-once retry can deliver a round's report twice; the stray
-    duplicate must not overwrite the full round's metrics (not at arrival,
-    and not later via finalize())."""
-    service, _ = make_service()
+    """At-least-once retry can deliver a round's reports twice; the stray
+    duplicates must not overwrite the full round's metrics (not at
+    arrival, and not later via finalize())."""
+    service, manager = make_service()
     service.trigger_evaluation(model_version=5)
+    t1, t2 = _eval_tasks(manager, 2)
     good = np.array([[0.9, 0.1], [0.2, 0.8]])
-    report(service, 5, good, np.array([0, 1]))
-    report(service, 5, good, np.array([0, 1]))  # completes the round: acc=1.0
+    report(service, 5, good, np.array([0, 1]), t1.task_id)
+    manager.report(t1.task_id, True, 0)
+    report(service, 5, good, np.array([0, 1]), t2.task_id)
+    manager.report(t2.task_id, True, 0)  # completes the round: acc=1.0
     assert service.latest_metrics == {"accuracy": 1.0}
-    # Late duplicate with all-wrong labels.
+    # Late duplicate with all-wrong labels (and a stray completion).
     report(service, 5, good, np.array([1, 0]))
     assert service.latest_metrics == {"accuracy": 1.0}
     service.finalize()  # must not resurrect the dropped duplicate
+    assert service.latest_metrics == {"accuracy": 1.0}
+
+
+def test_dead_attempt_chunks_never_promoted():
+    """At-least-once retry during eval: a failed attempt's PARTIAL chunks
+    must not double-count rows — each attempt has a fresh task id, and
+    only the completing attempt's staged chunks promote into the round."""
+    service, manager = make_service()
+    service.trigger_evaluation(model_version=9)
+    t1, t2 = _eval_tasks(manager, 2)
+    good = np.array([[0.9, 0.1], [0.2, 0.8]])
+    bad = np.array([[0.1, 0.9], [0.8, 0.2]])  # all-wrong attempt chunks
+    # Attempt 1 of task 1 flushes a chunk, then DIES (report failure).
+    report(service, 9, bad, np.array([0, 1]), t1.task_id)
+    manager.report(t1.task_id, False, 0)
+    # The retry (fresh id) redoes the task from scratch.
+    retry = manager.get(1)
+    assert retry.task_id != t1.task_id
+    report(service, 9, good, np.array([0, 1]), retry.task_id)
+    manager.report(retry.task_id, True, 1)
+    report(service, 9, good, np.array([0, 1]), t2.task_id)
+    manager.report(t2.task_id, True, 0)
+    # Dead attempt's rows excluded: accuracy is computed on 4 rows, all
+    # correct — not dragged down by the stale chunk.
     assert service.latest_metrics == {"accuracy": 1.0}

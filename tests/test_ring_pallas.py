@@ -1,0 +1,107 @@
+"""Pallas-engined ring attention (VERDICT round-2 #3: fuse the flash
+kernel into the ring steps).
+
+Exact-parity pinning against dense numerics and against the XLA ring
+engine on the 8-device CPU mesh (kernels run in Pallas interpret mode
+off-TPU), forward AND gradients, both layouts.  The lse-space step
+recombination and the ring-aware custom VJP (KV and their grads rotate
+together) are the new machinery under test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.parallel import MeshConfig, build_mesh
+from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from elasticdl_tpu.parallel.ring_attention import ring_self_attention
+from tests.test_ring_attention import _qkv, dense_attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_ring_matches_dense(causal):
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, k, v = _qkv(b=4, t=64)
+    out = ring_self_attention(mesh, q, k, v, causal=causal, impl="pallas")
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_ring_matches_xla_ring(causal):
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, k, v = _qkv(b=2, t=32, seed=5)
+    a = ring_self_attention(mesh, q, k, v, causal=causal, impl="pallas")
+    b_ = ring_self_attention(mesh, q, k, v, causal=causal, impl="xla")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5)
+
+
+def test_pallas_ring_zigzag_matches_dense():
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, k, v = _qkv(b=2, t=64, seed=9)
+    out = ring_self_attention(
+        mesh, q, k, v, causal=True, layout="zigzag", impl="pallas"
+    )
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_pallas_ring_gradients_match_dense():
+    """The ring-aware custom VJP: dq accumulates across steps, dk/dv ride
+    the rotation home — grads must equal dense attention's."""
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, k, v = _qkv(b=2, t=32, seed=7)
+    spec = P(DATA_AXIS, MODEL_AXIS, None, None)
+    sharding = NamedSharding(mesh, spec)
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+
+    def ring_loss(q, k, v):
+        out = ring_self_attention(mesh, q, k, v, causal=True, impl="pallas")
+        return jnp.sum(out ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(qs, ks, vs)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_ring, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=5e-4
+        )
+
+
+def test_pallas_ring_zigzag_gradients_match_dense():
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, k, v = _qkv(b=2, t=32, seed=11)
+
+    def ring_loss(q, k, v):
+        out = ring_self_attention(
+            mesh, q, k, v, causal=True, layout="zigzag", impl="pallas"
+        )
+        return jnp.sum(out ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_ring, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=5e-4
+        )
+
+
+def test_pallas_ring_bf16_inputs():
+    mesh = build_mesh(MeshConfig(data=2, model=4))
+    q, k, v = _qkv(b=2, t=32, seed=13, dtype=jnp.bfloat16)
+    out = ring_self_attention(mesh, q, k, v, causal=True, impl="pallas")
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+    )
