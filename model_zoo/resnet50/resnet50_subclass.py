@@ -75,6 +75,13 @@ class ResNet50(nn.Module):
     dtype: Dtype = jnp.bfloat16
     norm_dtype: Dtype = jnp.float32
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    # Stem note: the standard TPU space-to-depth transform (fold 2x2
+    # patches -> [B,112,112,12], 4x4 unstrided conv) was MEASURED on the
+    # v5e in round 3 and LOST: 2,102 img/s vs 2,665 for the plain 7x7/s2
+    # stem (BASELINE.md roofline section).  The step is activation-
+    # bandwidth-bound, not stem-bound, so the extra fold relayout costs
+    # more than the lane-packing saves.  Don't re-add without new
+    # evidence.
 
     @nn.compact
     def __call__(self, x, train: bool = False):
