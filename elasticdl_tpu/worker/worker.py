@@ -180,6 +180,9 @@ class Worker:
                 model_version=task.model_version,
                 model_outputs=concat_named(outputs_list),
                 labels=concat_named(labels_list),
+                # Reports stage per task on the master and promote when
+                # the task completes (retry-safe chunked-report protocol).
+                task_id=task.task_id,
             )
         return {TaskExecCounterKey.BATCH_COUNT: batch_count}
 
