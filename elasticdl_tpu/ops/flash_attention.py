@@ -1,7 +1,7 @@
 """Pallas TPU flash-attention kernel (forward + backward).
 
 The hot op of the long-context path — single-chip (`flash_attention`)
-AND per-ring-step inside the cross-chip ring (`flash_ring_step` /
+AND per-ring-step inside the cross-chip ring (`flash_ring_step_carry` /
 `flash_ring_step_bwd`, consumed by parallel/ring_attention's pallas
 impl; measured 1.25x-3x over the ring's XLA block math as T_local grows
 2048 -> 16384, BASELINE.md).  A hand-scheduled Pallas
@@ -341,10 +341,13 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, g):
 # ----------------------------------------------------------------------
 
 
-def _fwd_ring_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref,
-                     lse_ref, *, scale, causal, block_k):
-    """One q-block vs the ring step's whole KV block; emits the UNscaled
-    partial (out_i normalized by its own l_i, plus lse_i)."""
+def _fwd_ring_carry_kernel(q_ref, k_ref, v_ref, acc_ref, lsec_ref,
+                           qpos_ref, kpos_ref, acc_out, lse_out, *,
+                           scale, causal, block_k):
+    """_fwd_ring_kernel with the lse-space COMBINE fused in: takes the
+    running (acc, lse) carry as inputs (aliased to the outputs — no
+    fresh HBM buffers) and emits the updated carry directly, saving the
+    separate [B,H,T,D]-sized combine pass per ring step."""
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, D]
     t_k = k_ref.shape[2]
     n_k = t_k // block_k
@@ -360,10 +363,9 @@ def _fwd_ring_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref,
         )
         if causal:
             q_pos = qpos_ref[0, 0]  # [block_q, 1]
-            k_pos = kpos_ref[0, 0, :, pl.ds(j * block_k, block_k)]  # [1, block_k]
+            k_pos = kpos_ref[0, 0, :, pl.ds(j * block_k, block_k)]
             s = jnp.where(k_pos > q_pos, NEG_INF, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        # Fully-masked-so-far rows: keep the exp argument finite.
         safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - safe_m)
         if causal:
@@ -383,11 +385,65 @@ def _fwd_ring_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref,
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    # lse of an untouched row is NEG_INF (drops out of the combine).
-    lse_ref[0, 0] = jnp.where(
-        l == 0.0, NEG_INF, jnp.where(m <= NEG_INF / 2, 0.0, m) + jnp.log(l_safe)
+    o_i = acc / l_safe
+    lse_i = jnp.where(
+        l == 0.0, NEG_INF,
+        jnp.where(m <= NEG_INF / 2, 0.0, m) + jnp.log(l_safe),
     )
+    # Fused lse-space combine with the incoming carry.
+    lse_c = lsec_ref[0, 0]  # [block_q, 1]
+    lse_new = jnp.logaddexp(lse_c, lse_i)
+    safe = jnp.where(lse_new <= NEG_INF / 2, 0.0, lse_new)
+    alpha = jnp.exp(jnp.where(lse_c <= NEG_INF / 2, NEG_INF, lse_c) - safe)
+    beta = jnp.exp(jnp.where(lse_i <= NEG_INF / 2, NEG_INF, lse_i) - safe)
+    acc_out[0, 0] = acc_ref[0, 0] * alpha + o_i * beta
+    lse_out[0, 0] = lse_new
+
+
+def flash_ring_step_carry(q, k_blk, v_blk, acc, lse, q_pos, k_pos, *,
+                          causal, scale, block_q=DEFAULT_BLOCK,
+                          block_k=DEFAULT_BLOCK, interpret=None):
+    """One ring step, combine fused: (acc [B,H,Tq,D] f32, lse [B,H,Tq,1]
+    f32) in -> updated (acc, lse) out, buffers aliased in place."""
+    b, h, tq, d = q.shape
+    tk = k_blk.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"ring-step kernel needs block-divisible shard lengths; got "
+            f"Tq={tq} (block {block_q}), Tk={tk} (block {block_k})"
+        )
+    interpret = _use_interpret() if interpret is None else interpret
+    qp = _match_vma(q_pos.astype(jnp.int32).reshape(1, 1, tq, 1), q)
+    kp = _match_vma(k_pos.astype(jnp.int32).reshape(1, 1, 1, tk), q)
+    acc_new, lse_new = pl.pallas_call(
+        functools.partial(
+            _fwd_ring_carry_kernel, scale=scale, causal=causal,
+            block_k=block_k,
+        ),
+        grid=(b, h, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (0, 0, i, 0)),
+            pl.BlockSpec((1, 1, 1, tk), lambda b, h, i: (0, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            _out_struct((b, h, tq, d), jnp.float32, q),
+            _out_struct((b, h, tq, 1), jnp.float32, q),
+        ],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )(q, k_blk, v_blk, acc, lse, qp, kp)
+    return acc_new, lse_new
 
 
 def _out_struct(shape, dtype, like):
@@ -410,50 +466,6 @@ def _match_vma(x, like):
     have = getattr(jax.typeof(x), "vma", None) or frozenset()
     missing = tuple(set(want) - set(have))
     return jax.lax.pvary(x, missing) if missing else x
-
-
-def flash_ring_step(q, k_blk, v_blk, q_pos, k_pos, *, causal, scale,
-                    block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK,
-                    interpret=None):
-    """One ring step's partial attention.  q [B,H,Tq,D] (kernel layout),
-    k/v [B,H,Tk,D], positions int32 [Tq]/[Tk].  Returns (out_i
-    [B,H,Tq,D] f32, lse_i [B,H,Tq,1] f32)."""
-    b, h, tq, d = q.shape
-    tk = k_blk.shape[2]
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
-        raise ValueError(
-            f"ring-step kernel needs block-divisible shard lengths; got "
-            f"Tq={tq} (block {block_q}), Tk={tk} (block {block_k}) — the "
-            "truncating grid would silently drop tail rows"
-        )
-    interpret = _use_interpret() if interpret is None else interpret
-    qp = _match_vma(q_pos.astype(jnp.int32).reshape(1, 1, tq, 1), q)
-    kp = _match_vma(k_pos.astype(jnp.int32).reshape(1, 1, 1, tk), q)
-    out, lse = pl.pallas_call(
-        functools.partial(
-            _fwd_ring_kernel, scale=scale, causal=causal, block_k=block_k
-        ),
-        grid=(b, h, tq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, tk, d), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, tk, d), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (0, 0, i, 0)),
-            pl.BlockSpec((1, 1, 1, tk), lambda b, h, i: (0, 0, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-        ],
-        out_shape=[
-            _out_struct((b, h, tq, d), jnp.float32, q),
-            _out_struct((b, h, tq, 1), jnp.float32, q),
-        ],
-        interpret=interpret,
-    )(q, k_blk, v_blk, qp, kp)
-    return out, lse
 
 
 def _dq_ring_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,

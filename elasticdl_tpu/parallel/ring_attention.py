@@ -291,9 +291,11 @@ def _ring_axis_geometry(cfg, tq, tk):
 
 def _ring_pallas_forward(cfg, q, k, v):
     """Forward ring: each step runs the flash kernel on the rotating KV
-    block and steps recombine exactly in lse space (a fully-masked
-    step's lse_i = NEG_INF contributes exp(-inf) = 0)."""
-    from elasticdl_tpu.ops.flash_attention import flash_ring_step
+    block with the lse-space combine FUSED into the kernel epilogue
+    (flash_ring_step_carry — the (acc, lse) carry buffers alias in
+    place, so no per-step [B,H,T,D] combine pass ever touches HBM; a
+    fully-masked step's lse_i = NEG_INF contributes exp(-inf) = 0)."""
+    from elasticdl_tpu.ops.flash_attention import flash_ring_step_carry
 
     axis_name, causal, scale, layout, interpret = cfg
     tq, tk = q.shape[1], k.shape[1]
@@ -308,19 +310,13 @@ def _ring_pallas_forward(cfg, q, k, v):
         acc, lse_c, k_blk, v_blk = carry
         src = (my_index - step) % axis_size
         k_pos = _shard_positions(src, tk, axis_size, layout)
-        o_i, lse_i = flash_ring_step(
-            qk, _to_kernel(k_blk), _to_kernel(v_blk), q_pos, k_pos,
-            causal=causal, scale=scale, interpret=interpret,
-        )
-        lse_new = jnp.logaddexp(lse_c, lse_i)
-        safe = jnp.where(lse_new <= NEG_INF / 2, 0.0, lse_new)
-        acc = (
-            acc * jnp.exp(jnp.where(lse_c <= NEG_INF / 2, NEG_INF, lse_c) - safe)
-            + o_i * jnp.exp(jnp.where(lse_i <= NEG_INF / 2, NEG_INF, lse_i) - safe)
+        acc, lse_c = flash_ring_step_carry(
+            qk, _to_kernel(k_blk), _to_kernel(v_blk), acc, lse_c,
+            q_pos, k_pos, causal=causal, scale=scale, interpret=interpret,
         )
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (acc, lse_new, k_blk, v_blk), None
+        return (acc, lse_c, k_blk, v_blk), None
 
     (acc, lse, _, _), _ = jax.lax.scan(
         body, (acc0, lse0, k, v), jnp.arange(axis_size)
