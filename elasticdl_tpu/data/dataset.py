@@ -143,6 +143,16 @@ class SequentialRecords:
         if self._template is None and self._pending is None:
             self._pending = next(self._it, None)
             self._template = self._pending
+        if self._template is None:
+            # Stacking a None "record" would produce an object-dtype batch
+            # and an inscrutable downstream failure; the real problem is a
+            # source that yielded nothing for a range its shard metadata
+            # claims (short file, reader bug).
+            raise ValueError(
+                "dataset produced zero records — no batch-shape template "
+                "exists (does the reader's shard metadata overstate the "
+                "source's rows?)"
+            )
         return self._template
 
     def slice(self, lo: int, hi: int) -> list:

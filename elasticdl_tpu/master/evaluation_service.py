@@ -78,11 +78,24 @@ class EvaluationService:
     def trigger_evaluation(self, model_version: int):
         """Queue one evaluation round at `model_version`."""
         count = self._task_manager.create_evaluation_tasks(model_version)
+        complete = False
         with self._lock:
             if count > 0:
                 self._expected_tasks[model_version] = (
                     self._expected_tasks.get(model_version, 0) + count
                 )
+                # The tasks became dispatchable the moment create returned;
+                # a tiny round can have COMPLETED all of them before the
+                # expected count above was recorded (each completion saw
+                # expected=None).  Re-run the completion check so such a
+                # round finalizes now instead of at job-end finalize().
+                complete = (
+                    model_version not in self._finalized_versions
+                    and self._completed_tasks.get(model_version, 0)
+                    >= self._expected_tasks[model_version]
+                )
+        if complete:
+            self._finalize_round(model_version)
 
     # ------------------------------------------------------------------
     # Aggregation

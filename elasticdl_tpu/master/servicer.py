@@ -30,6 +30,7 @@ class MasterServicer(_Base):
         self._rendezvous_server = rendezvous_server
         self._checkpoint_service = checkpoint_service
         self._model_version = 0
+        self._zero_task_warned: set = set()
 
     @property
     def model_version(self) -> int:
@@ -66,6 +67,22 @@ class MasterServicer(_Base):
 
     def report_evaluation_metrics(self, request, context):
         if self._evaluation_service is not None:
+            if not request.task_id and (
+                request.model_version not in self._zero_task_warned
+            ):
+                # Chunked eval reports stage under (version, task_id) and
+                # only promote when that task completes; task ids start at
+                # 1, so a proto3-default 0 (an out-of-date worker binary
+                # that predates chunked reports) would stage rows nothing
+                # ever promotes.  Make the protocol mismatch visible
+                # instead of silently losing the round's metrics.
+                self._zero_task_warned.add(request.model_version)
+                logger.warning(
+                    "report_evaluation_metrics for version %d arrived "
+                    "without a task_id (worker/master protocol mismatch?) "
+                    "— its rows will not join the round's metrics",
+                    request.model_version,
+                )
             self._evaluation_service.report_evaluation_metrics(
                 request.model_version,
                 list(request.model_outputs),

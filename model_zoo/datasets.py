@@ -140,6 +140,70 @@ def synthetic_ctr_reader(
     return _CTRReader()
 
 
+def synthetic_ctr_columns(
+    n: int,
+    num_dense: int = 13,
+    num_categorical: int = 26,
+    vocab_size: int = 1000,
+    weights_seed: int = 0,
+    draw_seed: int = 1,
+    zipf_s: float = 0.0,
+):
+    """Vectorized, ground-truth CTR columns at benchmark scale.
+
+    The columnar counterpart of `synthetic_ctr_reader` for experiments
+    that need millions of rows (the per-record list there is host-bound):
+    returns `(dense [n, D] f32, cats [n, C] i32, labels [n] i32)` drawn
+    from a fixed ground-truth model — per-(field, id) embedding effects
+    plus a dense linear term — so train and held-out splits generated
+    with the SAME `weights_seed` but different `draw_seed`s share one
+    learnable distribution (the convergence-A/B contract,
+    scripts/convergence_ab.py).
+
+    Labels are Bernoulli(sigmoid(logit)) with both logit terms scaled to
+    ~unit variance: the Bayes AUC sits near 0.84, Criteo-like, so metric
+    differences between optimizer configs are visible above a
+    deterministic-label ceiling.
+
+    `zipf_s > 0` draws category ids from a truncated Zipf(s) instead of
+    uniform — hot rows are touched many times per step/window, which is
+    the adversarial case for windowed sparse apply (a hot row gets ONE
+    summed-gradient Adam update per window instead of W sequential ones).
+    """
+    wrng = np.random.default_rng(weights_seed)
+    field_weights = wrng.standard_normal(
+        (num_categorical, vocab_size)
+    ).astype(np.float32)
+    dense_weights = wrng.standard_normal((num_dense,)).astype(np.float32)
+
+    rng = np.random.default_rng(draw_seed)
+    dense = rng.standard_normal((n, num_dense)).astype(np.float32)
+    if zipf_s > 0.0:
+        # Truncated-Zipf inverse-CDF sampling: rank r gets mass
+        # 1/(r+1)^s; ids are rank-ordered (id 0 hottest), which is fine —
+        # the table is offset per field, so per-field hot sets are
+        # disjoint rows exactly as with a permuted mapping.
+        pmf = 1.0 / np.power(np.arange(1, vocab_size + 1), zipf_s)
+        cdf = np.cumsum(pmf / pmf.sum())
+        u = rng.random(size=(n, num_categorical))
+        cats = np.searchsorted(cdf, u).astype(np.int32)
+    else:
+        cats = rng.integers(
+            0, vocab_size, size=(n, num_categorical)
+        ).astype(np.int32)
+    cat_logit = np.take_along_axis(
+        field_weights.T, cats, axis=0
+    ).sum(axis=1, dtype=np.float64)
+    logits = (
+        dense @ dense_weights / np.sqrt(num_dense)
+        + cat_logit / np.sqrt(num_categorical)
+    ).astype(np.float32)
+    labels = (
+        rng.random(size=n) < 1.0 / (1.0 + np.exp(-logits))
+    ).astype(np.int32)
+    return dense, cats, labels
+
+
 def synthetic_classification_reader(
     n: int, num_features: int, num_classes: int, seed: int = 0, shard_name="synth"
 ):

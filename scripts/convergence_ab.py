@@ -1,0 +1,242 @@
+"""Convergence A/B: strict (W=1) vs windowed sparse apply (W>1).
+
+The question this answers (round-4 VERDICT item #1): the 26M-row
+north-star throughput headline uses `--sparse_apply_every=16` — the
+async-PS-style staleness relaxation (ps_trainer._train_chunk_impl) —
+and nothing measured whether W=16 trains models as well as strict
+per-step mode.  This script runs the controlled experiment:
+
+- ONE synthetic-Criteo distribution (model_zoo.datasets.
+  synthetic_ctr_columns): fixed ground-truth weights, Bernoulli labels
+  (Bayes AUC ~0.84), Zipf id draws by default — hot rows are touched
+  many times per window, the ADVERSARIAL case for windowed apply (a hot
+  row gets one summed-gradient Adam update per window instead of W
+  sequential ones).  Uniform draws, and larger vocabs where each row is
+  touched less than once per window, are strictly easier.
+- Same train stream (same seed, same batch order), same model init
+  (trainer seed), same dense optimizer for every config; the ONLY
+  variable is `sparse_apply_every` (plus one anchor run with the
+  default per-row-bias Adam to tie the A/B to the strict golden
+  contract).
+- Held-out eval (same ground truth, different draw seed) after every
+  epoch: AUC + logloss.
+
+Each config runs in its OWN subprocess (`--all`): two trainers in one
+process OOM the 16 GB chip, and process isolation also resets the
+tunnel/backend state between runs.  Within a config, train windows are
+staged to the device ONCE and replayed across epochs — the id pattern
+per window is huge (~10^7 draws), and identical streams across configs
+is exactly what the A/B wants.
+
+Results land as JSON lines; `--all` prints the aggregated table.  The
+round-4 BASELINE.md "Windowed-apply convergence" section records the
+outcome; tests/test_sparse_window.py pins a tiny-config version as a
+regression test.
+
+Usage:
+    python scripts/convergence_ab.py --all --out /tmp/conv_ab.jsonl
+    python scripts/convergence_ab.py --w 16 --bias global   # one config
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _logloss(logits: np.ndarray, labels: np.ndarray) -> float:
+    z = logits.astype(np.float64)
+    s = 2.0 * labels.astype(np.float64) - 1.0
+    return float(np.mean(np.logaddexp(0.0, -s * z)))
+
+
+def _auc(logits: np.ndarray, labels: np.ndarray) -> float:
+    from model_zoo.wide_and_deep.wide_and_deep import _auc as rank_auc
+
+    return float(rank_auc(logits, labels))
+
+
+def run_config(args) -> dict:
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh, sparse_optim
+    from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+    from model_zoo import datasets
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    n_train = args.batch * args.steps_per_epoch
+    dense, cats, labels = datasets.synthetic_ctr_columns(
+        n_train,
+        num_dense=zoo.NUM_DENSE,
+        num_categorical=zoo.NUM_CAT,
+        vocab_size=args.vocab,
+        weights_seed=0,
+        draw_seed=1,
+        zipf_s=args.zipf,
+    )
+    e_dense, e_cats, e_labels = datasets.synthetic_ctr_columns(
+        args.eval_examples,
+        num_dense=zoo.NUM_DENSE,
+        num_categorical=zoo.NUM_CAT,
+        vocab_size=args.vocab,
+        weights_seed=0,
+        draw_seed=2,
+        zipf_s=args.zipf,
+    )
+
+    mesh = build_mesh(MeshConfig())
+    trainer = ShardedEmbeddingTrainer(
+        zoo.custom_model(vocab_size=args.vocab),
+        zoo.loss,
+        zoo.optimizer(),
+        mesh,
+        embedding_optimizer=sparse_optim.adam(
+            0.001, bias_correction=args.bias
+        ),
+        sparse_apply_every=args.w,
+        seed=0,
+    )
+    mask = np.ones((args.batch,), np.float32)
+
+    def batch(i: int):
+        lo, hi = i * args.batch, (i + 1) * args.batch
+        return (
+            {"dense": dense[lo:hi], "cat": cats[lo:hi]},
+            labels[lo:hi],
+            mask,
+        )
+
+    trainer.ensure_initialized(batch(0)[0])
+    assert args.steps_per_epoch % args.window == 0
+    # A window that is not a multiple of W would end each window with a
+    # short tail chunk — the labeled W would overstate the actual applied
+    # staleness, which is the very thing under measurement.
+    assert args.window % args.w == 0, (args.window, args.w)
+    windows = [
+        trainer.stage_window(
+            [batch(w * args.window + i) for i in range(args.window)]
+        )
+        for w in range(args.steps_per_epoch // args.window)
+    ]
+
+    def evaluate() -> tuple[float, float]:
+        outs = []
+        for lo in range(0, args.eval_examples, args.batch):
+            feats = {
+                "dense": e_dense[lo : lo + args.batch],
+                "cat": e_cats[lo : lo + args.batch],
+            }
+            outs.append(np.asarray(trainer.eval_step(feats)))
+        logits = np.concatenate(outs)
+        return _auc(logits, e_labels), _logloss(logits, e_labels)
+
+    epochs = []
+    train_s = 0.0
+    for _ in range(args.epochs):
+        start = time.perf_counter()
+        losses = None
+        for win in windows:
+            losses = trainer.train_window(win)
+        final = np.asarray(losses)  # completion fence (see bench.py)
+        assert np.isfinite(final).all()
+        train_s += time.perf_counter() - start
+        auc, ll = evaluate()
+        epochs.append({"auc": round(auc, 5), "logloss": round(ll, 5)})
+
+    result = {
+        "w": args.w,
+        "bias": args.bias,
+        "vocab": args.vocab,
+        "zipf": args.zipf,
+        "epochs": epochs,
+        "final_auc": epochs[-1]["auc"],
+        "final_logloss": epochs[-1]["logloss"],
+        "train_samples_per_sec": round(
+            args.epochs * n_train / train_s, 1
+        ),
+    }
+    return result
+
+
+CONFIGS = [
+    (1, "per_row"),   # strict golden default — the anchor
+    (1, "global"),    # strict, headline-table optimizer
+    (4, "global"),
+    (8, "global"),
+    (16, "global"),   # the 26M headline configuration
+    (32, "global"),
+]
+
+
+def run_all(args) -> None:
+    rows = []
+    for w, bias in CONFIGS:
+        cmd = [
+            sys.executable, __file__,
+            "--w", str(w), "--bias", bias,
+            "--vocab", str(args.vocab), "--batch", str(args.batch),
+            "--steps-per-epoch", str(args.steps_per_epoch),
+            "--epochs", str(args.epochs),
+            "--eval-examples", str(args.eval_examples),
+            "--window", str(args.window), "--zipf", str(args.zipf),
+        ]
+        print(f"=== W={w} bias={bias} ===", flush=True)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            # A diverging config (NaN losses tripping the child's isfinite
+            # assert) IS a result — record it and keep sweeping; the other
+            # configs and the summary table must still come out.
+            print(proc.stdout[-4000:], file=sys.stderr)
+            print(proc.stderr[-4000:], file=sys.stderr)
+            result = {"w": w, "bias": bias, "status": "failed"}
+        else:
+            result = json.loads(proc.stdout.strip().splitlines()[-1])
+        rows.append(result)
+        line = json.dumps(result)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(line + "\n")
+    print("\n| W | bias | final AUC | final logloss | samples/s |")
+    print("|---|------|-----------|---------------|-----------|")
+    for r in rows:
+        if r.get("status") == "failed":
+            print(f"| {r['w']} | {r['bias']} | FAILED | FAILED | — |")
+            continue
+        print(
+            f"| {r['w']} | {r['bias']} | {r['final_auc']:.5f} "
+            f"| {r['final_logloss']:.5f} "
+            f"| {r['train_samples_per_sec']:,.0f} |"
+        )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--all", action="store_true")
+    p.add_argument("--w", type=int, default=1)
+    p.add_argument("--bias", choices=["per_row", "global"], default="global")
+    p.add_argument("--vocab", type=int, default=100_000)
+    p.add_argument("--batch", type=int, default=8192)
+    p.add_argument("--steps-per-epoch", type=int, default=480)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--eval-examples", type=int, default=262_144)
+    # 96 is a multiple of every swept W (1/4/8/16/32) — see the assert in
+    # run_config; 480 steps/epoch = 5 staged windows.
+    p.add_argument("--window", type=int, default=96)
+    p.add_argument("--zipf", type=float, default=1.1)
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+    if args.all:
+        run_all(args)
+    else:
+        print(json.dumps(run_config(args)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
