@@ -134,6 +134,16 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "outside a full window apply per-step.",
     )
     parser.add_argument(
+        "--oov_diagnostics", type=str2bool, nargs="?", const=True,
+        default=False,
+        help="Report per-step counts of embedding ids >= vocab_size in "
+        "worker logs instead of dropping them silently. The fixed-vocab "
+        "contract (docs/design.md): out-of-range ids read zeros and "
+        "receive no update — upstream ElasticDL's PS lazily grew such "
+        "rows; port open-vocabulary models by hashing ids into fixed "
+        "bins (preprocessing.Hashing).",
+    )
+    parser.add_argument(
         "--profile_steps", default="", type=_profile_steps_spec,
         help="'START,END': each worker captures a jax.profiler trace of "
         "its training steps in [START, END) under "

@@ -84,6 +84,19 @@ def load_module(model_zoo: str, model_def: str):
     return importlib.import_module(module_name)
 
 
+def _forward_flag(custom_model, model_params: dict, name, value) -> None:
+    """Inject a job-flag value into model_params when custom_model
+    declares the parameter and --model_params didn't set it explicitly."""
+    import inspect
+
+    try:
+        accepts = name in inspect.signature(custom_model).parameters
+    except (TypeError, ValueError):
+        accepts = False
+    if accepts and name not in model_params:
+        model_params[name] = value
+
+
 def load_model_spec(args) -> ModelSpec:
     """Resolve the model-zoo contract from parsed args."""
     module = load_module(args.model_zoo, args.model_def)
@@ -101,19 +114,22 @@ def load_model_spec(args) -> ModelSpec:
 
     custom_model = require("custom_model")
     model_params = parse_dict_params(args.model_params)
-    # --use_bf16 reaches the model here: a zoo model opts into mixed
-    # precision by accepting a `use_bf16` parameter (e.g. cifar10, which
-    # selects bfloat16 conv/activation dtype on the MXU).  Explicit
-    # --model_params wins over the flag; models without the parameter are
-    # untouched.
-    import inspect
-
-    try:
-        accepts_bf16 = "use_bf16" in inspect.signature(custom_model).parameters
-    except (TypeError, ValueError):
-        accepts_bf16 = False
-    if accepts_bf16 and "use_bf16" not in model_params:
-        model_params["use_bf16"] = bool(getattr(args, "use_bf16", True))
+    # Job flags reach opted-in models here: a zoo model declares the
+    # parameter on custom_model() and the flag value flows into
+    # model_params.  Explicit --model_params wins; models without the
+    # parameter are untouched.
+    # - use_bf16: mixed precision (e.g. cifar10's conv/activation dtype).
+    # - sparse_apply_every: per-mode table layout (deepfm splits its
+    #   merged table under strict apply at large scale — BASELINE.md
+    #   table-scale probe).
+    _forward_flag(
+        custom_model, model_params, "use_bf16",
+        bool(getattr(args, "use_bf16", True)),
+    )
+    _forward_flag(
+        custom_model, model_params, "sparse_apply_every",
+        int(getattr(args, "sparse_apply_every", 1) or 1),
+    )
 
     return ModelSpec(
         module=module,

@@ -146,8 +146,30 @@ class Embedding(nn.Module):
             jnp.array([spec.vocab_size, spec.dim], jnp.int32),
         )
         ids = jnp.asarray(ids).astype(jnp.int32)
-        valid = ids >= 0
+        # Fixed-vocab contract: ids outside [0, vocab) contribute zeros
+        # and receive no gradient.  Negative = padding (the documented
+        # input convention); >= vocab = out-of-vocabulary — the reference
+        # PS lazily grew such rows (†pkg/ps/embedding.go lookup-init), a
+        # fixed-shape XLA table cannot.  Without this mask a high id
+        # CLAMP-gathers the last storage block (silently wrong row).
+        # Migration rule + opt-in per-step OOV counting: docs/design.md.
+        valid = (ids >= 0) & (ids < self.vocab_size)
         safe_ids = jnp.where(valid, ids, 0)
+        if pk.oov_debug_enabled():
+            fmt = (
+                f"OOV diagnostics [{self.name or 'embedding'}]: "
+                "{c} ids >= vocab_size "
+                f"({self.vocab_size}) this step — they read zeros and "
+                "receive no update; hash open-vocabulary ids into fixed "
+                "bins (preprocessing.Hashing), see docs/design.md"
+            )
+            oov = jnp.sum((ids >= self.vocab_size).astype(jnp.int32))
+            jax.lax.cond(
+                oov > 0,
+                lambda c: jax.debug.print(fmt, c=c),
+                lambda c: None,
+                oov,
+            )
         # NOTE: no stop_gradient here. Under the PS-mode trainer the table
         # is a closure constant of the loss (not a grad argument), so no
         # dense cotangent is ever built — the sparse path owns the update.

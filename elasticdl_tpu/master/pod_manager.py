@@ -441,6 +441,7 @@ def worker_argv_from_args(args, master_addr: str) -> Callable[[int], List[str]]:
             "output", "use_bf16", "tensorboard_log_dir", "profile_steps",
             "train_window_steps", "dense_sharding", "mesh_model_axis",
             "sparse_apply_every", "jax_compilation_cache_dir",
+            "oov_diagnostics",
         },
     )
 

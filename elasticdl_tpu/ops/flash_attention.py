@@ -669,16 +669,53 @@ _flash.defvjp(_flash_fwd, _bwd)
 _KV_VMEM_BYTES_MAX = 8 * 1024 * 1024
 
 
+def shape_aligned(t: int, d: int, block: int = DEFAULT_BLOCK) -> bool:
+    """The pure shape-capability half of `supports()` (block/sublane
+    alignment), independent of the VMEM budget."""
+    block = min(block, t)
+    return t % block == 0 and t % 8 == 0 and d % 8 == 0
+
+
 def supports(t: int, d: int, block: int = DEFAULT_BLOCK) -> bool:
     """Whether the kernel handles this (seq_len, head_dim) shape within
     the default VMEM budget (see _KV_VMEM_BYTES_MAX)."""
-    block = min(block, t)
-    return (
-        t % block == 0
-        and t % 8 == 0
-        and d % 8 == 0
-        and 2 * t * d * 4 <= _KV_VMEM_BYTES_MAX
+    return shape_aligned(t, d, block) and not kv_vmem_exceeded(t, d)
+
+
+def kv_vmem_exceeded(t: int, d: int) -> bool:
+    """True when the KV block exceeds the flag-free scoped-VMEM budget —
+    the operator could unlock the kernel by raising
+    LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib (65536 is the
+    measured-working value at T=16384; BASELINE.md ring table).  Auto-
+    mode callers warn when this is the SOLE blocker (check
+    `shape_aligned` too — advising the flag on a misaligned shape would
+    point at a kernel that still cannot run)."""
+    return 2 * t * d * 4 > _KV_VMEM_BYTES_MAX
+
+
+# The measured-working scoped-VMEM limit for the long-T kernel shapes
+# (T=16384 D=64 and up; BASELINE.md ring table).
+VMEM_FLAG_ADVICE = "LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=65536"
+
+
+def warn_if_vmem_is_sole_blocker(logger_name: str, t: int, d: int) -> bool:
+    """Auto-mode honesty contract: when the Pallas kernel is rejected
+    ONLY by the VMEM budget (shape alignment fine), log the flag that
+    unlocks it — a silent fallback at long T leaves up to ~3x on the
+    table exactly where the kernel matters most.  Returns whether the
+    warning fired (trace-time, so once per compile)."""
+    if not (shape_aligned(t, d) and kv_vmem_exceeded(t, d)):
+        return False
+    from elasticdl_tpu.common.log_utils import get_logger
+
+    get_logger(logger_name).warning(
+        "attn impl=auto fell back to the XLA block engine at T=%d D=%d: "
+        "the KV block (%.1f MiB f32) exceeds the flag-free scoped-VMEM "
+        "budget. Set %s and force attn_impl=pallas to unlock the Pallas "
+        "kernel (up to ~3x at long T; BASELINE.md ring-attention table).",
+        t, d, 2 * t * d * 4 / 2**20, VMEM_FLAG_ADVICE,
     )
+    return True
 
 
 def flash_attention(

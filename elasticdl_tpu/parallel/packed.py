@@ -44,6 +44,29 @@ import numpy as np
 
 LANES = 128
 
+# Opt-in out-of-vocabulary diagnostics (--oov_diagnostics / env
+# ELASTICDL_OOV_DEBUG).  The fixed-vocab contract (docs/design.md
+# "Fixed-vocabulary embedding tables"): ids outside [0, vocab) contribute
+# zeros and receive no update — the reference's Go PS instead lazily
+# GREW a row on first lookup, so a ported open-vocabulary model loses
+# updates silently here.  With diagnostics on, the Embedding layer
+# reports per-step OOV counts (jax.debug.print host callback) so that
+# migration gap is visible instead of silent.
+import os as _os
+
+_OOV_DEBUG = _os.environ.get("ELASTICDL_OOV_DEBUG", "").strip().lower() in (
+    "1", "true", "yes", "on",
+)
+
+
+def set_oov_debug(enabled: bool) -> None:
+    global _OOV_DEBUG
+    _OOV_DEBUG = bool(enabled)
+
+
+def oov_debug_enabled() -> bool:
+    return _OOV_DEBUG
+
 
 def _pad_dim(dim: int) -> int:
     """Smallest power-of-two >= dim that divides 128, or a multiple of 128

@@ -414,14 +414,19 @@ def _ring_dispatch(q, k, v, *, axis_name, causal, scale=None,
     the chip, BASELINE.md), 'xla' = the blockwise einsum engine, 'auto' =
     pallas whenever the kernel supports the local shard shape."""
     if impl == "auto":
-        from elasticdl_tpu.ops.flash_attention import supports
+        from elasticdl_tpu.ops.flash_attention import (
+            supports,
+            warn_if_vmem_is_sole_blocker,
+        )
 
         t, d = q.shape[1], q.shape[3]
-        impl = (
-            "pallas"
-            if supports(t, d) and supports(k.shape[1], d)
-            else "xla"
-        )
+        tk = k.shape[1]
+        ok = supports(t, d) and supports(tk, d)
+        impl = "pallas" if ok else "xla"
+        if not ok:
+            warn_if_vmem_is_sole_blocker(
+                "parallel.ring_attention", max(t, tk), d
+            )
     if impl == "pallas":
         return ring_attention_pallas(
             q, k, v, axis_name=axis_name, causal=causal, scale=scale,

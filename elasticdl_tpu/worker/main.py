@@ -40,6 +40,10 @@ def main(argv=None):
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if getattr(args, "oov_diagnostics", False):
+        from elasticdl_tpu.parallel import packed
+
+        packed.set_oov_debug(True)
     model_spec = load_model_spec(args)
     data_reader = build_data_reader(args, model_spec, args.training_data)
     validation_reader = (

@@ -185,6 +185,9 @@ def synthetic_ctr_columns(
         # disjoint rows exactly as with a permuted mapping.
         pmf = 1.0 / np.power(np.arange(1, vocab_size + 1), zipf_s)
         cdf = np.cumsum(pmf / pmf.sum())
+        # Float error can leave cdf[-1] slightly below 1.0, and a uniform
+        # draw landing above it would searchsorted to vocab_size (OOB).
+        cdf[-1] = 1.0
         u = rng.random(size=(n, num_categorical))
         cats = np.searchsorted(cdf, u).astype(np.int32)
     else:

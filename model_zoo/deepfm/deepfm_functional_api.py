@@ -29,10 +29,33 @@ NUM_CAT = 26
 VOCAB = 1000
 
 
+# Auto table-layout crossover (see DeepFM.split_tables): measured on the
+# v5e at the 26M-row probe (BASELINE.md "table-scale probe").  Strict
+# per-step mode pays table-sized streaming passes whose cost scales with
+# DESTINATION BLOCKS; merging the dim-1 linear into a dim-9 (pad 16)
+# table doubled those blocks (1.83M -> 3.25M) and strict throughput fell
+# 192k -> 157k.  Windowed mode (sparse_apply_every > 1) amortizes the
+# passes, so the merged table's halved count-bound cost wins there.
+SPLIT_TABLE_ROWS = 10_000_000
+
+
 class DeepFM(nn.Module):
     vocab_size: int = VOCAB
     embedding_dim: int = 8
     hidden: int = 128
+    # Per-mode table layout: None = auto (split under strict per-step
+    # sparse apply once the table passes SPLIT_TABLE_ROWS rows — the
+    # regime where destination-block cost dominates count-bound cost).
+    split_tables: bool | None = None
+    sparse_apply_every: int = 1
+
+    def _split(self, total_vocab: int) -> bool:
+        if self.split_tables is not None:
+            return self.split_tables
+        return (
+            self.sparse_apply_every <= 1
+            and total_vocab > SPLIT_TABLE_ROWS
+        )
 
     @nn.compact
     def __call__(self, features, train: bool = False):
@@ -43,18 +66,32 @@ class DeepFM(nn.Module):
         flat_ids = cats + offsets[None, :]
         total_vocab = self.vocab_size * cats.shape[-1]
 
-        # ONE merged table of dim 1+d: lane 0 is the first-order (linear)
-        # weight, lanes 1..d the FM/deep field vector.  The reference keeps
-        # two tables (linear + fm); merging them halves the count-bound
-        # sparse costs — one lookup gather and one grad scatter-add per
-        # step instead of two (measured ~25 ns/row each on the v5e chip,
-        # the dominant per-step device cost at every table scale).
         first_dense = nn.Dense(1, name="linear_dense")(dense)[..., 0]
-        merged = Embedding(
-            total_vocab, 1 + self.embedding_dim, name="fm_embedding"
-        )(flat_ids)                                          # [B, 26, 1+d]
-        first_cat = jnp.sum(merged[..., 0], axis=-1)         # [B]
-        cat_emb = merged[..., 1:]                            # [B, 26, d]
+        if self._split(total_vocab):
+            # TWO tables (the reference's layout: linear + fm).  Costs a
+            # second lookup gather + grad scatter (~25 ns/row each), but
+            # the dim-1 table packs 128 rows/block and the dim-8 table
+            # 16 rows/block — 1.83M destination blocks at the 26M probe
+            # vs the merged table's 3.25M, which is what strict mode's
+            # per-step table-sized passes charge for.
+            linear = Embedding(
+                total_vocab, 1, name="linear_embedding"
+            )(flat_ids)                                      # [B, 26, 1]
+            first_cat = jnp.sum(linear[..., 0], axis=-1)     # [B]
+            cat_emb = Embedding(
+                total_vocab, self.embedding_dim, name="fm_embedding"
+            )(flat_ids)                                      # [B, 26, d]
+        else:
+            # ONE merged table of dim 1+d: lane 0 is the first-order
+            # (linear) weight, lanes 1..d the FM/deep field vector —
+            # halves the count-bound sparse costs (one gather + one
+            # scatter per step instead of two), the right trade except
+            # under strict mode at >10M rows (see SPLIT_TABLE_ROWS).
+            merged = Embedding(
+                total_vocab, 1 + self.embedding_dim, name="fm_embedding"
+            )(flat_ids)                                      # [B, 26, 1+d]
+            first_cat = jnp.sum(merged[..., 0], axis=-1)     # [B]
+            cat_emb = merged[..., 1:]                        # [B, 26, d]
         dense_emb = nn.DenseGeneral(
             (NUM_DENSE, self.embedding_dim), axis=-1, name="dense_projection"
         )(dense[:, None, :])[:, 0]                           # [B, 13, d]
@@ -75,8 +112,23 @@ class DeepFM(nn.Module):
         return first_cat + first_dense + second + deep  # logit
 
 
-def custom_model(vocab_size: int = VOCAB, embedding_dim: int = 8, hidden: int = 128):
-    return DeepFM(vocab_size=vocab_size, embedding_dim=embedding_dim, hidden=hidden)
+def custom_model(
+    vocab_size: int = VOCAB,
+    embedding_dim: int = 8,
+    hidden: int = 128,
+    split_tables: bool | None = None,
+    sparse_apply_every: int = 1,
+):
+    """`sparse_apply_every` arrives from the job flag (model_utils
+    forwards it to models declaring the parameter) and drives the auto
+    table layout; `--model_params split_tables=...` overrides."""
+    return DeepFM(
+        vocab_size=vocab_size,
+        embedding_dim=embedding_dim,
+        hidden=hidden,
+        split_tables=split_tables,
+        sparse_apply_every=sparse_apply_every,
+    )
 
 
 def loss(labels, predictions):

@@ -74,7 +74,10 @@ class CausalSelfAttention(nn.Module):
 
     def _single_device_attend(self, t: int, head_dim: int):
         from elasticdl_tpu.ops import flash_attention
-        from elasticdl_tpu.ops.flash_attention import supports
+        from elasticdl_tpu.ops.flash_attention import (
+            supports,
+            warn_if_vmem_is_sole_blocker,
+        )
 
         use_pallas = self.attn_impl == "pallas" or (
             self.attn_impl == "auto"
@@ -83,6 +86,8 @@ class CausalSelfAttention(nn.Module):
         )
         if use_pallas:
             return partial(flash_attention, causal=True)
+        if self.attn_impl == "auto" and jax.default_backend() == "tpu":
+            warn_if_vmem_is_sole_blocker("model_zoo.transformer", t, head_dim)
         return partial(blockwise_attention, causal=True)
 
     @nn.compact

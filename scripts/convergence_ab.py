@@ -92,12 +92,15 @@ def run_config(args) -> dict:
 
     mesh = build_mesh(MeshConfig())
     trainer = ShardedEmbeddingTrainer(
-        zoo.custom_model(vocab_size=args.vocab),
+        # Same rule as bench.py: the model's per-mode table layout must
+        # see the SAME apply mode the trainer runs, or a headline-scale
+        # A/B would validate a layout the headline never uses.
+        zoo.custom_model(vocab_size=args.vocab, sparse_apply_every=args.w),
         zoo.loss,
         zoo.optimizer(),
         mesh,
         embedding_optimizer=sparse_optim.adam(
-            0.001, bias_correction=args.bias
+            args.emb_lr, bias_correction=args.bias
         ),
         sparse_apply_every=args.w,
         seed=0,
@@ -152,6 +155,7 @@ def run_config(args) -> dict:
     result = {
         "w": args.w,
         "bias": args.bias,
+        "emb_lr": args.emb_lr,
         "vocab": args.vocab,
         "zipf": args.zipf,
         "epochs": epochs,
@@ -185,6 +189,7 @@ def run_all(args) -> None:
             "--epochs", str(args.epochs),
             "--eval-examples", str(args.eval_examples),
             "--window", str(args.window), "--zipf", str(args.zipf),
+            "--emb-lr", str(args.emb_lr),
         ]
         print(f"=== W={w} bias={bias} ===", flush=True)
         proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -230,6 +235,11 @@ def main():
     # run_config; 480 steps/epoch = 5 staged windows.
     p.add_argument("--window", type=int, default=96)
     p.add_argument("--zipf", type=float, default=1.1)
+    # Embedding-table Adam lr.  A window contributes ONE Adam-normalized
+    # update where strict mode contributes W, so scaling this with W is
+    # the natural knob for closing the windowed warmup gap (measured in
+    # the r04 A/B follow-up).
+    p.add_argument("--emb-lr", type=float, default=0.001)
     p.add_argument("--out", default="")
     args = p.parse_args()
     if args.all:

@@ -72,3 +72,47 @@ def test_use_bf16_reaches_opted_in_models():
     spec = load_model_spec(parse_master_args(
         base + ["--model_def", "mnist.mnist_functional_api"]))
     assert "use_bf16" not in spec.model_params
+
+
+def test_sparse_apply_every_reaches_layout_aware_models():
+    """--sparse_apply_every flows into model_params for zoo models whose
+    custom_model declares the parameter (deepfm's per-mode table layout);
+    explicit model_params win; other models are untouched."""
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.model_utils import load_model_spec
+
+    base = ["--model_zoo", "model_zoo", "--training_data", "t"]
+    spec = load_model_spec(parse_master_args(
+        base + ["--model_def", "deepfm.deepfm_functional_api",
+                "--sparse_apply_every", "16"]))
+    assert spec.model_params["sparse_apply_every"] == 16
+    spec = load_model_spec(parse_master_args(
+        base + ["--model_def", "deepfm.deepfm_functional_api"]))
+    assert spec.model_params["sparse_apply_every"] == 1
+    spec = load_model_spec(parse_master_args(
+        base + ["--model_def", "deepfm.deepfm_functional_api",
+                "--sparse_apply_every", "16",
+                "--model_params", "sparse_apply_every=1"]))
+    assert spec.model_params["sparse_apply_every"] == 1
+    spec = load_model_spec(parse_master_args(
+        base + ["--model_def", "mnist.mnist_functional_api"]))
+    assert "sparse_apply_every" not in spec.model_params
+
+
+def test_oov_diagnostics_flag_round_trip():
+    from elasticdl_tpu.common.args import (
+        args_to_argv,
+        parse_master_args,
+        parse_worker_args,
+    )
+
+    argv = ["--model_zoo", "z", "--model_def", "m", "--training_data", "t",
+            "--oov_diagnostics"]
+    args = parse_master_args(argv)
+    assert args.oov_diagnostics is True
+    worker_argv = args_to_argv(args, keys={"model_zoo", "model_def",
+                                           "oov_diagnostics"})
+    again = parse_worker_args(
+        ["--worker_id", "0", "--master_addr", "x"] + worker_argv
+    )
+    assert again.oov_diagnostics is True

@@ -62,3 +62,47 @@ def test_ctr_model_trains_on_sharded_mesh(model_def):
         name: fn(out, labels) for name, fn in zoo.eval_metrics_fn().items()
     }
     assert 0.0 <= metrics["auc"] <= 1.0
+
+
+def test_deepfm_split_table_layout_trains():
+    """The strict-mode large-table layout (BASELINE.md table-scale
+    probe): split_tables=True builds TWO embedding tables (linear dim-1
+    + fm dim-8, the reference's layout) and still learns."""
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    mesh = build_mesh(MeshConfig())
+    trainer = ShardedEmbeddingTrainer(
+        zoo.custom_model(vocab_size=100, split_tables=True),
+        zoo.loss,
+        zoo.optimizer(lr=0.01),
+        mesh,
+        embedding_optimizer=zoo.embedding_optimizer(lr=0.01),
+    )
+    losses = []
+    for epoch in range(6):
+        for feats, labels in _batches(zoo, n=64, mb=16):
+            losses.append(float(trainer.train_step(feats, labels)))
+    assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
+    state = trainer.state
+    assert len(state.tables) == 2, list(state.tables)
+    dims = sorted(
+        trainer._table_specs[k].dim for k in state.tables
+    )
+    assert dims == [1, zoo.custom_model().embedding_dim]
+
+
+def test_deepfm_auto_layout_selection():
+    """Auto layout: merged table except under strict per-step apply at
+    >SPLIT_TABLE_ROWS rows (the measured destination-block crossover)."""
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    big_vocab = zoo.SPLIT_TABLE_ROWS // zoo.NUM_CAT + 1
+    assert zoo.custom_model(vocab_size=100)._split(100 * zoo.NUM_CAT) is False
+    strict_big = zoo.custom_model(vocab_size=big_vocab, sparse_apply_every=1)
+    assert strict_big._split(big_vocab * zoo.NUM_CAT) is True
+    windowed_big = zoo.custom_model(
+        vocab_size=big_vocab, sparse_apply_every=16
+    )
+    assert windowed_big._split(big_vocab * zoo.NUM_CAT) is False
+    forced = zoo.custom_model(vocab_size=100, split_tables=True)
+    assert forced._split(100 * zoo.NUM_CAT) is True

@@ -141,6 +141,46 @@ class TestEmbeddingLayer:
         table, out = self._apply(Embedding(VOCAB, DIM, combiner="sum"), ids)
         np.testing.assert_allclose(out[0], table[1] + table[2], rtol=1e-5)
 
+    def test_high_oov_ids_read_zeros(self):
+        """The fixed-vocab contract (docs/design.md): ids >= vocab_size
+        contribute zeros, exactly like negative padding — NOT a clamped
+        read of the last row (what the raw gather would do)."""
+        ids = jnp.asarray([[1, VOCAB, VOCAB + 7], [2 * VOCAB, 3, -1]],
+                          jnp.int32)
+        table, out = self._apply(Embedding(VOCAB, DIM), ids)
+        np.testing.assert_allclose(out[0, 0], table[1], rtol=1e-6)
+        np.testing.assert_allclose(out[0, 1], np.zeros(DIM), atol=0)
+        np.testing.assert_allclose(out[0, 2], np.zeros(DIM), atol=0)
+        np.testing.assert_allclose(out[1, 0], np.zeros(DIM), atol=0)
+        np.testing.assert_allclose(out[1, 1], table[3], rtol=1e-6)
+        np.testing.assert_allclose(out[1, 2], np.zeros(DIM), atol=0)
+
+    def test_oov_diagnostics_prints_count(self, capfd):
+        from elasticdl_tpu.parallel import packed as pk
+
+        pk.set_oov_debug(True)
+        try:
+            ids = jnp.asarray([[1, VOCAB + 5, VOCAB]], jnp.int32)
+            self._apply(Embedding(VOCAB, DIM, name="probe"), ids)
+            jax.effects_barrier()
+        finally:
+            pk.set_oov_debug(False)
+        captured = capfd.readouterr()
+        assert "OOV diagnostics [probe]" in captured.out, captured
+        assert "2 ids >= vocab_size" in captured.out, captured
+
+    def test_oov_diagnostics_silent_when_in_range(self, capfd):
+        from elasticdl_tpu.parallel import packed as pk
+
+        pk.set_oov_debug(True)
+        try:
+            ids = jnp.asarray([[1, 2, -1]], jnp.int32)
+            self._apply(Embedding(VOCAB, DIM), ids)
+            jax.effects_barrier()
+        finally:
+            pk.set_oov_debug(False)
+        assert "OOV diagnostics" not in capfd.readouterr().out
+
 
 # ---------------------------------------------------------------------------
 # Training equivalence: the sparse path (stop_gradient + perturbation +

@@ -158,3 +158,74 @@ def test_windowed_single_apply_per_chunk():
     # and step counter already pin — here we check each traced apply saw
     # the 3-step concatenation.
     assert all(n == 16 * 3 * 3 for n in calls)
+
+
+def test_windowed_apply_convergence_parity():
+    """Convergence tripwire for the windowed-apply semantics trade (the
+    r04 A/B, scripts/convergence_ab.py + BASELINE.md "Windowed-apply
+    convergence"): on the same learnable Zipf CTR stream, W=8 windowed
+    apply must reach the same best held-out AUC as strict W=1 within a
+    generous tolerance (measured diff at this scale: ~0.0006; on the
+    chip-scale A/B, peak AUC at W=16/32 matched strict within 0.003).
+    A real staleness bug — dropped window grads, mis-concatenated chunk
+    ids, double-applied chunks — moves AUC far beyond 0.03."""
+    from model_zoo import datasets
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+    from model_zoo.wide_and_deep.wide_and_deep import _auc
+
+    vocab, batch, spe, epochs = 200, 256, 16, 3
+    dense, cats, labels = datasets.synthetic_ctr_columns(
+        batch * spe, vocab_size=vocab, weights_seed=0, draw_seed=1,
+        zipf_s=1.1,
+    )
+    e_dense, e_cats, e_labels = datasets.synthetic_ctr_columns(
+        2048, vocab_size=vocab, weights_seed=0, draw_seed=2, zipf_s=1.1
+    )
+
+    def run(w: int) -> float:
+        mesh = build_mesh(MeshConfig())
+        trainer = ShardedEmbeddingTrainer(
+            zoo.custom_model(vocab_size=vocab),
+            zoo.loss,
+            zoo.optimizer(),
+            mesh,
+            embedding_optimizer=sparse_optim.adam(
+                0.001, bias_correction="global"
+            ),
+            sparse_apply_every=w,
+            seed=0,
+        )
+        mask = np.ones((batch,), np.float32)
+
+        def make_batch(i):
+            lo, hi = i * batch, (i + 1) * batch
+            return (
+                {"dense": dense[lo:hi], "cat": cats[lo:hi]},
+                labels[lo:hi],
+                mask,
+            )
+
+        trainer.ensure_initialized(make_batch(0)[0])
+        window = trainer.stage_window([make_batch(i) for i in range(spe)])
+        best = 0.0
+        for _ in range(epochs):
+            losses = trainer.train_window(window)
+            assert np.isfinite(np.asarray(losses)).all()
+            outs = [
+                np.asarray(
+                    trainer.eval_step(
+                        {
+                            "dense": e_dense[lo : lo + batch],
+                            "cat": e_cats[lo : lo + batch],
+                        }
+                    )
+                )
+                for lo in range(0, 2048, batch)
+            ]
+            best = max(best, _auc(np.concatenate(outs), e_labels))
+        return best
+
+    strict, windowed = run(1), run(8)
+    assert strict > 0.58, f"strict run failed to learn (AUC {strict})"
+    assert windowed > 0.58, f"windowed run failed to learn (AUC {windowed})"
+    assert abs(strict - windowed) < 0.03, (strict, windowed)
