@@ -662,6 +662,33 @@ class ShardedEmbeddingTrainer:
         self._pending_sharded_restore = None
         shardings = self._state_shardings(template)
         dense = saver.load_dense(step)
+        if hasattr(saver, "manifest"):
+            # Fail with the CAUSE when the checkpoint's table set differs
+            # from this build's (a bare KeyError on 'table|...' is
+            # undiagnosable).  The usual way to get here: a per-mode
+            # table layout changed between runs — e.g. DeepFM merges its
+            # linear+fm tables under windowed sparse apply but splits
+            # them under strict mode at >10M rows, so changing
+            # --sparse_apply_every across a restart changes the model's
+            # table structure.
+            have = {
+                name[len("table|"):]
+                for name in saver.manifest(step).get("arrays", {})
+                if name.startswith("table|")
+            }
+            want = set(template.tables)
+            if have != want:
+                raise ValueError(
+                    f"Checkpoint at step {step} holds embedding tables "
+                    f"{sorted(have)} but this build expects "
+                    f"{sorted(want)} — the model's table layout changed "
+                    "between save and restore (e.g. DeepFM's per-mode "
+                    "layout splits/merges tables when "
+                    "--sparse_apply_every crosses the strict/windowed "
+                    "boundary at >10M rows). Restore with the same "
+                    "sparse_apply_every, or pin the layout with "
+                    "--model_params split_tables=true|false"
+                )
         tables = {
             k: saver.load_array(step, f"table|{k}", shardings.tables[k])
             for k in template.tables

@@ -199,6 +199,35 @@ class Block(nn.Module):
         return x + nn.Dense(e, dtype=self.dtype)(h)
 
 
+class _Bf16AccF32Head(nn.Module):
+    """LM head with bf16 operands and f32 accumulation/output: params
+    stay f32 and use nn.Dense's names (kernel/bias), so checkpoints are
+    interchangeable with the f32 head; only the matmul INPUTS round to
+    bf16 (the MXU's native mode — same numerics as the bf16 blocks),
+    while logits and the loss softmax stay full precision."""
+
+    vocab: int
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (x.shape[-1], self.vocab),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.vocab,), jnp.float32
+        )
+        logits = jax.lax.dot_general(
+            x.astype(jnp.bfloat16),
+            kernel.astype(jnp.bfloat16),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return logits + bias
+
+
 class TransformerLM(nn.Module):
     vocab: int = VOCAB
     d_model: int = 128
@@ -214,9 +243,21 @@ class TransformerLM(nn.Module):
     # — trades ~30% more FLOPs for O(layers) less activation memory, the
     # standard long-context lever.
     remat: bool = False
+    # LM-head matmul precision.  "f32": f32 x f32 (the conservative
+    # default).  "bf16": bf16 operands on the MXU with f32 ACCUMULATION
+    # and f32 logits out (preferred_element_type) — the same numerics as
+    # every other matmul in the bf16 blocks; the head is ~half the
+    # model's FLOPs at this vocab/d_model, so its matmul rate moves the
+    # headline (BASELINE.md long-context section).
+    logits_compute: str = "f32"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
+        if self.logits_compute not in ("f32", "bf16"):
+            raise ValueError(
+                f"logits_compute must be 'f32' or 'bf16', "
+                f"got {self.logits_compute!r}"
+            )
         b, t = tokens.shape
         tok = nn.Embed(self.vocab, self.d_model, dtype=self.dtype)(tokens)
         pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype)(
@@ -232,6 +273,8 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.logits_compute == "bf16":
+            return _Bf16AccF32Head(self.vocab, name="lm_head")(x)
         # Logits in f32: the loss softmax wants full precision.
         return nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(x)
 
@@ -248,6 +291,7 @@ def custom_model(
     cp_layout: str = "contiguous",
     model_axis_mode: str = "cp",
     remat: bool = False,
+    logits_compute: str = "f32",
 ):
     """`mesh=None` -> single-device attention (Pallas flash kernel on
     TPU).  With the trainer's mesh and model axis > 1, `model_axis_mode`
@@ -269,6 +313,7 @@ def custom_model(
         cp_layout=cp_layout,
         model_axis_mode=model_axis_mode,
         remat=remat,
+        logits_compute=logits_compute,
     )
 
 

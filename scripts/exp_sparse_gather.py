@@ -1,0 +1,173 @@
+"""Perf experiment: can ANY engine beat the XLA row gather that bounds
+the sparse embedding path?  (VERDICT round-3 #7, time-boxed.)
+
+The 26M-row probe spends ~5.5 ms/step in lookup-gather + row ops and
+~2.7 ms in the grad scatter — count-bound at ~25 ns per touched row
+(BASELINE.md).  The only hypothesized path below that floor was a fused
+Pallas lookup/scatter engine.  This harness measures, on the real chip:
+
+  1. the raw XLA storage-row gather (pk.lookup minus the slot-select
+     einsum) — the incumbent;
+  2. full pk.lookup (gather + one-hot slot select) — what the model pays;
+  3. a Pallas scalar-prefetch gather: grid over ids, each step DMAs one
+     512 B storage row HBM->VMEM->HBM with the id stream scalar-prefetched
+     so the pipeline emitter double-buffers the row fetches.  This is the
+     idiomatic TPU formulation of a "coalesced DMA" gather (the round-3
+     experiment issued EXPLICIT per-row async copies instead and measured
+     a 0.3 us/row issue-bound floor);
+  4. the packed grad scatter-add (pk.scatter_add) — the write side.
+
+Compare against the arithmetic floors: 213k rows x 512 B = 109 MB moved
+twice (read + write) = ~0.27 ms at 819 GB/s IF the access were
+sequential — the gap between that and the measured rate is random-access
+row granularity, which no kernel formulation removes.
+
+Usage: python scripts/exp_sparse_gather.py [n_ids] [vocab_rows]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+INNER = 32
+
+
+def _time(fn, *args) -> float:
+    import jax
+
+    jit_fn = jax.jit(fn)
+
+    def once():
+        start = time.perf_counter()
+        out = jit_fn(*args)
+        np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+        return time.perf_counter() - start
+
+    once()
+    once()
+    times = [once() for _ in range(5)]
+    return sorted(times)[2] / INNER
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from elasticdl_tpu.parallel import packed as pk
+    from elasticdl_tpu.parallel.packed import PackedSpec
+
+    n_ids = int(sys.argv[1]) if len(sys.argv) > 1 else 212_992
+    vocab = int(sys.argv[2]) if len(sys.argv) > 2 else 26_000_000
+    spec = PackedSpec(vocab, 16)  # dim 16: one row per 128-lane block
+    rng = np.random.RandomState(0)
+    # Generate directly in packed shape (a logical->packed relayout at
+    # 26M rows crashes the TPU compiler — BASELINE.md dead ends).
+    table = jnp.asarray(
+        rng.rand(*spec.packed_shape).astype(np.float32)
+    )
+    ids = jnp.asarray(
+        rng.randint(0, vocab, size=n_ids).astype(np.int32)
+    )
+    grads = jnp.asarray(rng.rand(n_ids, spec.dim).astype(np.float32))
+    print(
+        f"table {spec.packed_shape} ({table.nbytes / 2**30:.2f} GiB), "
+        f"{n_ids} ids", flush=True,
+    )
+
+    def loop(body):
+        def fn(*args):
+            def step(i, tot):
+                return tot + body(i, *args)
+
+            return jax.lax.fori_loop(0, INNER, step, jnp.float32(0))
+
+        return fn
+
+    # 1. raw storage-row gather (what jnp.take lowers to).
+    t = _time(
+        loop(lambda i, tb, ix: jnp.sum(jnp.take(tb, ix + i, axis=0))),
+        table, ids // spec.rows_per_block,
+    )
+    print(f"raw row gather:      {t * 1e3:7.3f} ms  "
+          f"{t / n_ids * 1e9:6.1f} ns/row", flush=True)
+
+    # 2. full packed lookup (gather + slot-select einsum).
+    t = _time(
+        loop(lambda i, tb, ix: jnp.sum(pk.lookup(spec, tb, ix + i))),
+        table, ids,
+    )
+    print(f"pk.lookup:           {t * 1e3:7.3f} ms  "
+          f"{t / n_ids * 1e9:6.1f} ns/row", flush=True)
+
+    # 3. Pallas scalar-prefetch gather: one DMA per grid step, the id
+    # stream scalar-prefetched so the pipeline emitter double-buffers
+    # the fetches.  Pallas TPU requires (8, 128)-aligned blocks, so each
+    # step fetches the aligned 8-row block CONTAINING the target row —
+    # 8x the useful bytes, but the per-step rate measures exactly what a
+    # one-row-per-step engine could ever achieve (a (1, 128) block is
+    # not lowerable; the per-useful-row cost of this engine is the
+    # per-step cost).
+    def gather_kernel(ids_ref, rows_ref, out_ref):
+        out_ref[...] = rows_ref[...].reshape(out_ref.shape)
+
+    def pallas_gather(tb, block_ix):
+        n = block_ix.shape[0]
+        return pl.pallas_call(
+            gather_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n,),
+                in_specs=[
+                    pl.BlockSpec(
+                        (8, spec.block_width),
+                        lambda i, ids_pref: (ids_pref[i], 0),
+                    ),
+                ],
+                out_specs=pl.BlockSpec(
+                    (1, 8, spec.block_width), lambda i, ids_pref: (i, 0, 0)
+                ),
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (n, 8, spec.block_width), tb.dtype
+            ),
+        )(block_ix, tb)
+
+    try:
+        t = _time(
+            loop(lambda i, tb, ix: jnp.sum(pallas_gather(tb, ix + i))),
+            table, ids // spec.rows_per_block // 8,
+        )
+        print(f"pallas sp gather:    {t * 1e3:7.3f} ms  "
+              f"{t / n_ids * 1e9:6.1f} ns/row", flush=True)
+    except Exception as e:  # noqa: BLE001 — record the failure mode
+        print(f"pallas sp gather:    FAILED ({type(e).__name__}: "
+              f"{str(e)[:200]})", flush=True)
+
+    # 4. grad scatter-add (the write side of the sparse path).
+    t = _time(
+        loop(
+            lambda i, tb, ix, g: jnp.sum(
+                pk.scatter_add(spec, tb, ix + i, g)[0]
+            )
+        ),
+        table, ids, grads,
+    )
+    print(f"pk.scatter_add:      {t * 1e3:7.3f} ms  "
+          f"{t / n_ids * 1e9:6.1f} ns/row", flush=True)
+
+    bw_floor_ms = 2 * n_ids * spec.block_width * 4 / 819e9 * 1e3
+    print(f"sequential-BW floor: {bw_floor_ms:7.3f} ms  "
+          f"{bw_floor_ms / n_ids * 1e6:6.1f} ns/row", flush=True)
+
+
+if __name__ == "__main__":
+    main()

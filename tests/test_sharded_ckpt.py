@@ -190,3 +190,38 @@ def test_garbage_collection_keeps_newest(tmp_path):
         trainer.train_step(ids, labels)
         trainer.save_checkpoint(saver, step)
     assert saver.steps() == [3, 4]
+
+
+def test_table_layout_mismatch_raises_with_cause(tmp_path):
+    """A checkpoint written under one table layout must refuse restore
+    into a build with a different table set — naming the per-mode
+    layout cause, not a bare KeyError.  The real-world trigger: DeepFM
+    merges linear+fm tables under windowed sparse apply but splits them
+    under strict mode at >10M rows, so flipping --sparse_apply_every
+    across a restart silently changes the model's table structure."""
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    mesh = build_mesh(MeshConfig())
+    saver = ShardedCheckpointSaver(str(tmp_path))
+    merged = ShardedEmbeddingTrainer(
+        zoo.custom_model(vocab_size=100, split_tables=False),
+        zoo.loss, zoo.optimizer(), mesh,
+        embedding_optimizer=zoo.embedding_optimizer(), seed=0,
+    )
+    rng = np.random.RandomState(0)
+    feats = {
+        "dense": rng.rand(8, zoo.NUM_DENSE).astype(np.float32),
+        "cat": rng.randint(0, 100, size=(8, zoo.NUM_CAT)).astype(np.int32),
+    }
+    labels = rng.randint(0, 2, size=8).astype(np.int32)
+    merged.train_step(feats, labels)
+    merged.save_checkpoint(saver, merged.step)
+
+    split = ShardedEmbeddingTrainer(
+        zoo.custom_model(vocab_size=100, split_tables=True),
+        zoo.loss, zoo.optimizer(), mesh,
+        embedding_optimizer=zoo.embedding_optimizer(), seed=0,
+    )
+    split.set_sharded_restore(saver, 1)
+    with pytest.raises(ValueError, match="table layout changed"):
+        split.ensure_initialized(feats)

@@ -275,3 +275,32 @@ def test_model_axis_mode_validated():
     tokens = jnp.zeros((4, 32), jnp.int32)
     with pytest.raises(ValueError, match="model_axis_mode"):
         model.init(jax.random.PRNGKey(0), tokens)
+
+
+def test_bf16_logits_head_parity_and_checkpoint_names():
+    """logits_compute='bf16' (MXU-native head: bf16 operands, f32
+    accumulate/out) must produce the same parameter tree as the f32 head
+    (checkpoint-interchangeable) and logits within bf16 rounding of it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from model_zoo.transformer import transformer_lm as zoo
+
+    kwargs = dict(vocab=128, d_model=64, num_heads=2, num_layers=1,
+                  max_len=32)
+    f32 = zoo.custom_model(**kwargs)
+    bf16 = zoo.custom_model(logits_compute="bf16", **kwargs)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, size=(2, 32)), jnp.int32
+    )
+    v32 = f32.init(jax.random.PRNGKey(0), tokens)
+    v16 = bf16.init(jax.random.PRNGKey(0), tokens)
+    paths32 = {p for p, _ in jax.tree_util.tree_flatten_with_path(v32)[0]}
+    paths16 = {p for p, _ in jax.tree_util.tree_flatten_with_path(v16)[0]}
+    assert paths32 == paths16
+    out32 = f32.apply(v32, tokens)
+    out16 = bf16.apply(v32, tokens)  # SAME params through the bf16 head
+    assert out16.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(out16), np.asarray(out32), rtol=0.05, atol=0.05
+    )
