@@ -378,22 +378,24 @@ def bench_resnet50(
 
 def bench_transformer(
     batch_size: int = 16,  # B=8 -> 241k, B=16 -> 245k tokens/sec
-    seq_len: int = 2048,
     steps_per_window: int = 20,
     repeats: int = 5,
 ):
-    """Long-context config (net-new vs the reference): 4-layer d512 causal
-    LM, T=2048, Pallas flash-attention kernel (ops/flash_attention.py)."""
+    """Long-context config (net-new vs the reference): TRANSFORMER_BENCH
+    causal LM, Pallas flash-attention kernel (ops/flash_attention.py)."""
     import jax
 
     from elasticdl_tpu.parallel import MeshConfig, build_mesh
     from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
     from model_zoo.transformer import transformer_lm as zoo
 
+    cfg = TRANSFORMER_BENCH
+    vocab, seq_len = cfg["vocab"], cfg["seq_len"]
     mesh = build_mesh(MeshConfig())
     trainer = DataParallelTrainer(
         zoo.custom_model(
-            vocab=32768, d_model=512, num_heads=8, num_layers=4,
+            vocab=vocab, d_model=cfg["d_model"],
+            num_heads=cfg["num_heads"], num_layers=cfg["num_layers"],
             max_len=seq_len,
         ),
         zoo.loss,
@@ -404,10 +406,10 @@ def bench_transformer(
 
     def make_batch():
         return (
-            rng.randint(0, 32768, size=(batch_size, seq_len)).astype(
+            rng.randint(0, vocab, size=(batch_size, seq_len)).astype(
                 np.int32
             ),
-            rng.randint(0, 32768, size=(batch_size, seq_len)).astype(
+            rng.randint(0, vocab, size=(batch_size, seq_len)).astype(
                 np.int32
             ),
             np.ones((batch_size,), np.float32),
@@ -451,17 +453,28 @@ SPARSE_FLOOR_NS_PER_ROW = 25.0
 HOST_PARSE_CEILING_RPS = 1.94e6
 
 
+# ONE definition of the transformer bench's model shape, consumed by
+# both bench_transformer (builds the model) and the roofline accounting
+# (computes FLOPs/token) — divergent copies would silently break the
+# emitted mfu.
+TRANSFORMER_BENCH = dict(
+    vocab=32768, d_model=512, num_heads=8, num_layers=4, seq_len=2048,
+    mlp_ratio=4,
+)
+
+
 def _transformer_flops_per_token() -> float:
-    """Analytic fwd FLOPs/token for the bench config (d512 L4 V32k T2048
-    mlp4x, causal); train = 3x fwd.  2*m*n per [m,n] matmul contraction;
-    causal attention touches T/2 keys on average."""
-    d, layers, vocab, seq, mlp = 512, 4, 32768, 2048, 4
+    """Analytic fwd FLOPs/token for TRANSFORMER_BENCH (causal);
+    train = 3x fwd.  2*m*n per [m,n] matmul contraction; causal
+    attention touches T/2 keys on average."""
+    cfg = TRANSFORMER_BENCH
+    d, layers = cfg["d_model"], cfg["num_layers"]
     per_layer = (
-        8 * d * d            # qkv (6d^2) + output proj (2d^2)
-        + 4 * mlp * d * d    # mlp up (2*d*4d) + down (2*4d*d)
-        + 4 * d * (seq / 2)  # QK^T + PV against T/2 causal keys
+        8 * d * d                          # qkv (6d^2) + output proj (2d^2)
+        + 4 * cfg["mlp_ratio"] * d * d     # mlp up + down
+        + 4 * d * (cfg["seq_len"] / 2)     # QK^T + PV, T/2 causal keys
     )
-    return 2 * d * vocab + layers * per_layer
+    return 2 * d * cfg["vocab"] + layers * per_layer
 
 
 def _roofline_fields(metric: str, value: float) -> dict:

@@ -128,10 +128,22 @@ def save_model(trainer, output_path: str, args=None):
         return
     from elasticdl_tpu.serving import export_model
 
+    # Record the RESOLVED model params — job flags that model_utils
+    # injects into model_params (sparse_apply_every, use_bf16) included
+    # — not the raw --model_params string: a flag-dependent model
+    # structure (DeepFM's per-mode table layout follows
+    # sparse_apply_every at >10M rows) must rebuild identically at
+    # serving load, where the job flags no longer exist.
+    model_params = getattr(args, "model_params", "")
+    if args is not None and getattr(args, "model_def", ""):
+        from elasticdl_tpu.common.args import format_dict_params
+        from elasticdl_tpu.common.model_utils import load_model_spec
+
+        model_params = format_dict_params(load_model_spec(args).model_params)
     export_model(
         trainer,
         output_path,
         model_zoo=getattr(args, "model_zoo", ""),
         model_def=getattr(args, "model_def", ""),
-        model_params=getattr(args, "model_params", ""),
+        model_params=model_params,
     )

@@ -294,6 +294,27 @@ def parse_dict_params(params: str) -> dict:
     return result
 
 
+def format_dict_params(params: dict) -> str:
+    """Inverse of parse_dict_params: {'a': 1, 'b': True} -> 'a=1,b=true'.
+    Used to record the RESOLVED model params (job flags injected by
+    model_utils._forward_flag included) into serving artifacts, so a
+    reload rebuilds the exact trained model — e.g. DeepFM's table layout
+    follows sparse_apply_every, and an artifact recording only the raw
+    --model_params string would rebuild the wrong structure."""
+    def fmt(value):
+        if isinstance(value, bool):
+            return "true" if value else "false"
+        return str(value)
+
+    for key, value in params.items():
+        if isinstance(value, str) and ("," in value or "=" in value):
+            raise ValueError(
+                f"model param {key}={value!r} cannot round-trip "
+                "through the k=v,k=v format"
+            )
+    return ",".join(f"{k}={fmt(v)}" for k, v in sorted(params.items()))
+
+
 def args_to_argv(args: argparse.Namespace, keys=None) -> list:
     """Round-trip a namespace back into --flag value argv (client -> pods)."""
     argv = []

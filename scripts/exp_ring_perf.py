@@ -24,12 +24,13 @@ xla|pallas = engine, bqN/bkN = kernel block sizes, rN = ring steps
 from __future__ import annotations
 
 import functools
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 H, D = 8, 128
 REPEATS = 5

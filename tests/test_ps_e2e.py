@@ -259,3 +259,44 @@ def test_table_shards_are_disjoint_per_device():
     # DeepFM ships ONE merged table (linear lane 0 + fm lanes) since the
     # round-3 scatter-cost fix — see model_zoo/deepfm.
     assert checked == len(trainer.state.tables) == 1
+
+
+def test_ps_mode_windowed_sparse_apply_cluster(tmp_path):
+    """--sparse_apply_every=4 through the REAL master/worker gRPC world:
+    the headline large-table configuration's flag must round-trip
+    client -> master -> worker, grow the dispatch window to a multiple
+    of W (collective_worker), run the chunked apply, and finish every
+    record.  Trainer-level windowed semantics are pinned in
+    test_sparse_window; this is the cluster wiring."""
+    n_records = 512
+    args = parse_master_args([
+        "--model_zoo=model_zoo",
+        "--model_def=deepfm.deepfm_functional_api",
+        f"--training_data=synthetic://criteo?n={n_records}&vocab=100",
+        "--model_params=vocab_size=100",
+        "--records_per_task=128",
+        "--minibatch_size=8",
+        "--num_workers=2",
+        "--distribution_strategy=ParameterServerStrategy",
+        "--sparse_apply_every=4",
+    ])
+    rendezvous = ElasticRendezvous()
+    master = start_master(args, rendezvous_server=rendezvous)
+    manager = LocalProcessManager(
+        num_workers=2,
+        worker_argv_fn=worker_argv_from_args(args, master.addr),
+        rendezvous=rendezvous,
+        task_manager=master.task_manager,
+        max_restarts=0,
+        worker_env=WORKER_ENV,
+        log_dir=str(tmp_path / "logs"),
+        job_finished_fn=master.task_manager.finished,
+    )
+    try:
+        manager.start()
+        assert manager.wait(timeout=480) is True
+        assert master.task_manager.finished()
+        assert master.task_manager.finished_record_count == n_records
+    finally:
+        manager.stop()
+        master.stop()

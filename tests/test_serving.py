@@ -115,3 +115,55 @@ np.save({str(tmp_path / 'out.npy')!r}, out)
     )
     got = np.load(tmp_path / "out.npy")
     np.testing.assert_array_equal(expected, got)
+
+
+def test_export_records_resolved_model_params(tmp_path):
+    """Flag-dependent model structure must survive the serving
+    round-trip: save_model records the RESOLVED model params (the job
+    flags model_utils injects — sparse_apply_every, use_bf16), so a
+    reload rebuilds the exact trained structure.  The real-world hazard:
+    DeepFM trained at >10M rows with --sparse_apply_every=16 uses the
+    MERGED table layout; an artifact recording only the raw
+    --model_params would rebuild the SPLIT layout at load and fail on
+    missing parameters."""
+    import json as _json
+
+    from elasticdl_tpu.client.api import save_model
+    from elasticdl_tpu.common.args import parse_master_args
+
+    zoo, trainer, batches = _trained_deepfm()
+    args = parse_master_args([
+        "--model_zoo=model_zoo",
+        "--model_def=deepfm.deepfm_functional_api",
+        "--training_data=synthetic://criteo?n=64&vocab=100",
+        "--model_params=vocab_size=100",
+        "--sparse_apply_every=16",
+    ])
+    out_dir = str(tmp_path / "export")
+    save_model(trainer, out_dir, args)
+    sig = _json.loads((tmp_path / "export" / "signature.json").read_text())
+    recorded = sig["model_params"]
+    assert "sparse_apply_every=16" in recorded, recorded
+    assert "vocab_size=100" in recorded, recorded
+    # And the reload consumes them: the rebuilt model sees the flag.
+    served = load_for_serving(out_dir)
+    assert served._model.sparse_apply_every == 16
+    feats, _ = batches[0]
+    got = np.asarray(served.predict(feats))
+    expected = np.asarray(trainer.eval_step(feats))
+    np.testing.assert_allclose(expected, got, rtol=1e-5)
+
+
+def test_format_dict_params_round_trip():
+    from elasticdl_tpu.common.args import (
+        format_dict_params,
+        parse_dict_params,
+    )
+
+    params = {"vocab_size": 100, "use_bf16": True, "lr": 0.5,
+              "mode": "auto", "split_tables": False}
+    assert parse_dict_params(format_dict_params(params)) == params
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        format_dict_params({"bad": "a=b"})
