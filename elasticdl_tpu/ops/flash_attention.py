@@ -658,15 +658,33 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 # The forward kernel keeps each (batch, head)'s FULL [T, D] K and V
 # resident in VMEM (the backward kernels stream block-wise).  Cap the K+V
-# footprint auto-mode will accept: 8 MiB leaves room for the Q/output
-# blocks and the f32 accumulators inside the default ~16 MiB scoped-VMEM
-# budget (T=16384 x D=64 sits exactly at the cap and is measured to work;
-# beyond it, lowering fails unless the operator raises
-# LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib).  Explicit
-# flash_attention() calls are not bounded — only supports(), which
-# attention_impl='auto' consults before preferring the kernel over
-# blockwise_attention.
-_KV_VMEM_BYTES_MAX = 8 * 1024 * 1024
+# footprint auto-mode will accept: half the scoped-VMEM budget leaves
+# room for the Q/output blocks and the f32 accumulators (at the default
+# ~16 MiB budget that is 8 MiB: T=16384 x D=64 sits exactly at the cap
+# and is measured to work).  Explicit flash_attention() calls are not
+# bounded — only supports(), which attention_impl='auto' consults before
+# preferring the kernel over blockwise_attention.
+_DEFAULT_SCOPED_VMEM_KIB = 16 * 1024
+
+
+def _configured_scoped_vmem_kib() -> int:
+    """The scoped-VMEM budget the operator actually configured: parse
+    --xla_tpu_scoped_vmem_limit_kib out of LIBTPU_INIT_ARGS (round 4 —
+    previously auto mode capped at the FLAG-FREE bound even when the
+    operator had raised the limit, so the kernel silently fell back at
+    exactly the long-T shapes the flag exists for)."""
+    import os
+    import re
+
+    match = re.search(
+        r"--xla_tpu_scoped_vmem_limit_kib=(\d+)",
+        os.environ.get("LIBTPU_INIT_ARGS", ""),
+    )
+    return int(match.group(1)) if match else _DEFAULT_SCOPED_VMEM_KIB
+
+
+def _kv_vmem_bytes_max() -> int:
+    return _configured_scoped_vmem_kib() * 1024 // 2
 
 
 def shape_aligned(t: int, d: int, block: int = DEFAULT_BLOCK) -> bool:
@@ -678,19 +696,20 @@ def shape_aligned(t: int, d: int, block: int = DEFAULT_BLOCK) -> bool:
 
 def supports(t: int, d: int, block: int = DEFAULT_BLOCK) -> bool:
     """Whether the kernel handles this (seq_len, head_dim) shape within
-    the default VMEM budget (see _KV_VMEM_BYTES_MAX)."""
+    the CONFIGURED scoped-VMEM budget (LIBTPU_INIT_ARGS-aware)."""
     return shape_aligned(t, d, block) and not kv_vmem_exceeded(t, d)
 
 
 def kv_vmem_exceeded(t: int, d: int) -> bool:
-    """True when the KV block exceeds the flag-free scoped-VMEM budget —
-    the operator could unlock the kernel by raising
+    """True when the KV block exceeds the configured scoped-VMEM budget —
+    the operator can raise it with
     LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib (65536 is the
-    measured-working value at T=16384; BASELINE.md ring table).  Auto-
-    mode callers warn when this is the SOLE blocker (check
-    `shape_aligned` too — advising the flag on a misaligned shape would
-    point at a kernel that still cannot run)."""
-    return 2 * t * d * 4 > _KV_VMEM_BYTES_MAX
+    measured-working value at T=16384; BASELINE.md ring table), and auto
+    mode then accepts the shape without forcing attn_impl.  Auto-mode
+    callers warn when this is the SOLE blocker (check `shape_aligned`
+    too — advising the flag on a misaligned shape would point at a
+    kernel that still cannot run)."""
+    return 2 * t * d * 4 > _kv_vmem_bytes_max()
 
 
 # The measured-working scoped-VMEM limit for the long-T kernel shapes

@@ -158,3 +158,26 @@ def test_auto_mode_small_shape_no_vmem_warning():
         except Exception:
             pass
     assert "xla_tpu_scoped_vmem_limit_kib" not in buf.getvalue()
+
+
+def test_supports_honors_configured_vmem_flag(monkeypatch):
+    """auto-mode's VMEM bound follows the OPERATOR'S configured budget:
+    with LIBTPU_INIT_ARGS raising the scoped-VMEM limit, supports()
+    accepts the long-T shapes the flag exists for instead of silently
+    falling back to the XLA engine (round 4)."""
+    from elasticdl_tpu.ops.flash_attention import supports
+
+    # Flag-free: T=16384 D=64 sits exactly at the 8 MiB KV cap; T=32768
+    # exceeds it.
+    monkeypatch.delenv("LIBTPU_INIT_ARGS", raising=False)
+    assert supports(16384, 64)
+    assert not supports(32768, 64)
+    # Operator raises the budget 4x -> the 16 MiB KV block now fits.
+    monkeypatch.setenv(
+        "LIBTPU_INIT_ARGS", "--xla_tpu_scoped_vmem_limit_kib=65536"
+    )
+    assert supports(32768, 64)
+    assert not supports(262144, 64)  # still bounded
+    # Malformed/unrelated args fall back to the default budget.
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", "--some_other_flag=1")
+    assert not supports(32768, 64)
