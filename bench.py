@@ -1,16 +1,29 @@
 """Benchmark entrypoint: one JSON line per headline metric.
 
 Measured on whatever accelerator is visible (the driver provides one
-real TPU chip):
+real TPU chip), seven metrics:
 
 - `transformer_lm_tokens_per_sec_per_chip` (net-new long-context scope):
   causal-LM train step, T=2048, Pallas flash-attention kernel.
 - `resnet50_images_per_sec_per_chip` (config 5): ResNet-50 ImageNet
   train step (bf16 convs + BN compute, f32 stats/params) through the
   AllReduce-mode DataParallelTrainer.
+- `ring_attention_tokens_per_sec_per_chip`: the context-parallel path's
+  Pallas per-step block engine (round 4).
+- `deepfm_e2e_host_pipeline_records_per_sec` +
+  `deepfm_e2e_samples_per_sec_per_chip`: the production data-to-device
+  pipeline (the coupled number is tunnel-bound here, tracked=false).
+- `deepfm_26m_table_samples_per_sec_per_chip`: the north-star TABLE
+  scale (26M resident rows, windowed sparse apply W=32 — the
+  convergence-validated large-table config).
 - `deepfm_train_samples_per_sec_per_chip` (config 4, printed LAST — the
-  north-star headline): full ParameterServerStrategy step — packed
-  sharded embedding lookup, FM + deep tower, streaming sparse-Adam.
+  flagship headline, strict per-step golden contract): full
+  ParameterServerStrategy step — packed sharded embedding lookup, FM +
+  deep tower, streaming sparse-Adam.
+
+Every row carries a roofline field (mfu vs the 197 TF/s v5e bf16 peak,
+bw_frac vs 819 GB/s HBM, or ns-per-row vs the measured 25 ns/row sparse
+floor) so drift vs silicon is visible, not just drift vs last round.
 
 The reference publishes no numbers (BASELINE.md), so vs_baseline compares
 against this framework's own recorded round-1 values (resnet50 had no
