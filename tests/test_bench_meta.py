@@ -60,3 +60,26 @@ def test_emit_json_contract(capsys):
     assert row["tracked"] is False
     assert 0 < row["mfu"] < 1
     assert row["vs_baseline"] == pytest.approx(242_000.0 / 241_046.0, rel=1e-3)
+
+
+def test_ring_bench_harness_import():
+    """bench_ring_engine loads scripts/exp_ring_perf.py by file path; pin
+    the coupling (module loads, exposes run_variant, parses the exact
+    variant string the bench builds) without touching a device."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "exp_ring_perf_for_test",
+        os.path.join(
+            os.path.dirname(__file__), os.pardir, "scripts",
+            "exp_ring_perf.py",
+        ),
+    )
+    harness = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(harness)
+    assert callable(harness.run_variant)
+    cfg = harness.parse("t2048_b4_r4_pallas_i32")
+    assert (cfg["t"], cfg["b"], cfg["r"], cfg["engine"], cfg["inner"]) == (
+        2048, 4, 4, "pallas", 32,
+    )
