@@ -30,6 +30,28 @@ def validate_cluster_args(args, mode: str):
     parse_resource_spec(args.master_resource_request)
     parse_resource_spec(args.worker_resource_request)
     parse_volume_spec(args.volume)
+    if getattr(args, "tpu_slice", ""):
+        from elasticdl_tpu.master.tpu_slice import (
+            slice_spec,
+            validate_worker_count,
+        )
+
+        # Unknown shape or a worker count that can't tile the slice
+        # must fail in the operator's terminal, not strand a half-
+        # scheduled pod slice.
+        validate_worker_count(slice_spec(args.tpu_slice), args.num_workers)
+        if args.need_elasticity:
+            # Elastic shrink/grow changes the world size; a pod slice is
+            # all-or-nothing (num_workers == hosts, forever) — a 3-host
+            # world on a 4-host slice can't initialize its TPUs.  Reject
+            # here rather than hang in-cluster after a preemption.
+            raise ValueError(
+                "--tpu_slice is incompatible with --need_elasticity: a "
+                "TPU pod slice schedules all-or-nothing, so the worker "
+                "count cannot shrink or grow. Run the slice at fixed "
+                "size (restart-the-world recovery still replaces failed "
+                "workers 1:1 within the restart budget)."
+            )
     if (
         mode == Mode.TRAINING
         and args.need_elasticity

@@ -24,6 +24,12 @@ def non_neg_int(value):
     return ivalue
 
 
+def pos_int_or_auto(value):
+    if value == "auto":
+        return value
+    return pos_int(value)
+
+
 def _profile_steps_spec(value):
     """Validate --profile_steps AT PARSE TIME (master-side): a malformed
     spec must fail the submission, not crash-loop every worker pod until
@@ -121,7 +127,7 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "override the auto sizing entirely.",
     )
     parser.add_argument(
-        "--sparse_apply_every", type=pos_int, default=1,
+        "--sparse_apply_every", type=pos_int_or_auto, default="auto",
         help="ParameterServerStrategy only: apply the sparse embedding "
         "optimizer once per N train steps from the accumulated gradients "
         "(N=1 is strict per-step semantics). N>1 trades bounded "
@@ -129,9 +135,14 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "the async-PS behaviour of upstream ElasticDL — for amortizing "
         "the table-sized moment update, the dominant step cost once the "
         "per-chip table exceeds ~10M rows (BASELINE.md table-scale "
-        "probe). Chunks never span device dispatches: the worker grows "
-        "--train_window_steps to a multiple of N, and task-tail batches "
-        "outside a full window apply per-step.",
+        "probe). The default 'auto' resolves from the model's resident "
+        "table rows at init: strict (1) up to 10M rows, 32 above — the "
+        "convergence-validated large-table config (BASELINE.md "
+        "'Windowed-apply convergence'; upstream ElasticDL's async PS was "
+        "likewise its default mode). Pass 1 to force strict semantics at "
+        "any scale. Chunks never span device dispatches: the worker "
+        "grows --train_window_steps to a multiple of N, and task-tail "
+        "batches outside a full window apply per-step.",
     )
     parser.add_argument(
         "--oov_diagnostics", type=str2bool, nargs="?", const=True,
@@ -204,6 +215,15 @@ def add_cluster_arguments(parser: argparse.ArgumentParser):
     parser.add_argument(
         "--worker_resource_request", default="",
         help='k8s resources per worker pod, e.g. "cpu=4,memory=8Gi,google.com/tpu=1"',
+    )
+    parser.add_argument(
+        "--tpu_slice", default="",
+        help="Schedule workers onto a named TPU pod slice (e.g. "
+        "'v5e-16'): each worker pod is one TPU VM host — it requests "
+        "the host's chips (google.com/tpu) and pins to nodes of the "
+        "slice's accelerator/topology labels; --num_workers must equal "
+        "the slice's host count (v5e-16 = 4 hosts). See "
+        "master/tpu_slice.py for known shapes.",
     )
     parser.add_argument(
         "--volume", default="",
@@ -307,7 +327,10 @@ def format_dict_params(params: dict) -> str:
         return str(value)
 
     for key, value in params.items():
-        if isinstance(value, str) and ("," in value or "=" in value):
+        # ',' is the only non-round-trippable character: parse splits
+        # items on ',' before the first '=', so '=' inside a value (a
+        # URL, a nested spec) survives the round trip intact.
+        if isinstance(value, str) and "," in value:
             raise ValueError(
                 f"model param {key}={value!r} cannot round-trip "
                 "through the k=v,k=v format"

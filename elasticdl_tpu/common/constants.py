@@ -20,6 +20,14 @@ class JobType:
 class TaskExecCounterKey:
     BATCH_COUNT = "batch_count"
     RECORD_COUNT = "record_count"
+    # Out-of-vocabulary LOOKUPS seen by the task's train steps (PS mode;
+    # counted device-side per window, see layers.embedding
+    # OOV_COLLECTION).  A lookup is one (id, table) pair: a model that
+    # routes the same ids through two tables (e.g. DeepFM's split
+    # layout) counts each OOV id once per table — the count is an alarm
+    # signal whose zero/nonzero contract is layout-independent, but its
+    # magnitude follows the model's lookup structure.
+    OOV_LOOKUP_COUNT = "oov_lookup_count"
 
 
 class GRPC:

@@ -15,6 +15,9 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from elasticdl_tpu.common.args import parse_dict_params
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("common.model_utils")
 
 
 @dataclass
@@ -126,9 +129,30 @@ def load_model_spec(args) -> ModelSpec:
         custom_model, model_params, "use_bf16",
         bool(getattr(args, "use_bf16", True)),
     )
+    job_w = getattr(args, "sparse_apply_every", 1) or 1
+    if job_w != "auto":
+        job_w = int(job_w)
+    explicit_w = model_params.get("sparse_apply_every")
+    if explicit_w is not None and explicit_w != job_w and job_w != "auto":
+        # job_w == "auto" resolves only at trainer init, so no static
+        # comparison is possible here — and an explicit numeric layout
+        # pin under the auto default is the documented escape hatch, not
+        # an inconsistency; warning on every such job would be noise.
+        # An explicit --model_params sparse_apply_every wins over the job
+        # flag here (layout override is a supported escape hatch), but
+        # the trainer still APPLIES with the job flag's W — the model
+        # would run a layout the strict/windowed cost analysis picked for
+        # a different mode.  Numerically valid, so warn rather than fail.
+        logger.warning(
+            "model_params sparse_apply_every=%s overrides the job flag "
+            "--sparse_apply_every=%s for the TABLE LAYOUT only; the "
+            "trainer still applies with the job flag's interval. Drop "
+            "the model param unless you are deliberately pinning a "
+            "layout.",
+            explicit_w, job_w,
+        )
     _forward_flag(
-        custom_model, model_params, "sparse_apply_every",
-        int(getattr(args, "sparse_apply_every", 1) or 1),
+        custom_model, model_params, "sparse_apply_every", job_w,
     )
 
     return ModelSpec(

@@ -103,6 +103,13 @@ def _build_worker_manager(args, master, rendezvous, worker_env):
         )
         from elasticdl_tpu.master.k8s_pod_manager import KubernetesPodManager
 
+        if getattr(args, "tpu_slice", "") and args.need_elasticity:
+            # Mirrors client/submit's terminal-time rejection for masters
+            # launched without going through the client.
+            raise ValueError(
+                "--tpu_slice is incompatible with --need_elasticity "
+                "(pod slices schedule all-or-nothing; see client/submit)"
+            )
         client = K8sClient(K8sConfig.resolve(args.namespace))
         pod_ip = os.environ.get("MY_POD_IP", "") or socket.gethostbyname(
             socket.gethostname()
@@ -123,6 +130,7 @@ def _build_worker_manager(args, master, rendezvous, worker_env):
             priority_class=args.worker_pod_priority,
             owner_pod=owner,
             volume_spec=args.volume,
+            tpu_slice=getattr(args, "tpu_slice", ""),
             scale_up_check_fn=(
                 _K8sCapacityProbe() if args.need_elasticity else None
             ),

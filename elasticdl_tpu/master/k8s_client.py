@@ -464,6 +464,7 @@ def render_pod(
     owner: Optional[dict] = None,
     image_pull_policy: str = "IfNotPresent",
     volume_spec: str = "",
+    node_selector: Optional[Dict[str, str]] = None,
 ) -> dict:
     """One ElasticDL pod (master or worker).
 
@@ -513,6 +514,8 @@ def render_pod(
         }
     if priority_class:
         spec["priorityClassName"] = priority_class
+    if node_selector:
+        spec["nodeSelector"] = dict(node_selector)
     if volume_spec:
         volumes, mounts = parse_volume_spec(volume_spec)
         spec["volumes"] = volumes

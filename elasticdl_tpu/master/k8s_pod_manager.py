@@ -87,6 +87,7 @@ class KubernetesPodManager(ElasticWorkerManager):
         owner_pod: Optional[dict] = None,
         pod_startup_timeout_s: float = 300.0,
         volume_spec: str = "",
+        tpu_slice: str = "",
         **kwargs,
     ):
         super().__init__(num_workers, worker_argv_fn, **kwargs)
@@ -94,7 +95,24 @@ class KubernetesPodManager(ElasticWorkerManager):
         self._job_name = job_name
         self._image = image
         self._worker_env = dict(worker_env or {})
-        self._worker_resources = worker_resources
+        self._worker_resources = dict(worker_resources or {})
+        self._worker_node_selector: Dict[str, str] = {}
+        if tpu_slice:
+            # One worker pod per TPU VM host of the slice: the chip
+            # resource + node selectors come from the shape catalog
+            # (master/tpu_slice.py); submit-time validation already
+            # pinned num_workers == hosts.
+            from elasticdl_tpu.master.tpu_slice import (
+                slice_spec,
+                validate_worker_count,
+                worker_pod_overlay,
+            )
+
+            spec = slice_spec(tpu_slice)
+            validate_worker_count(spec, num_workers)
+            overlay = worker_pod_overlay(spec)
+            self._worker_resources.update(overlay["resources"])
+            self._worker_node_selector = overlay["node_selector"]
         self._priority_class = priority_class
         self._volume_spec = volume_spec
         self._owner_pod = owner_pod
@@ -283,10 +301,11 @@ class KubernetesPodManager(ElasticWorkerManager):
                 command=self._worker_argv_fn(wid),
                 namespace=self._client.namespace,
                 env=self._worker_env,
-                resources=self._worker_resources,
+                resources=self._worker_resources or None,
                 priority_class=self._priority_class,
                 owner=self._owner_pod,
                 volume_spec=self._volume_spec,
+                node_selector=self._worker_node_selector or None,
             )
             name = manifest["metadata"]["name"]
             with self._state_lock:

@@ -22,6 +22,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from elasticdl_tpu.common.constants import TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
@@ -255,6 +256,22 @@ class TaskManager:
                     eval_done_cbs = list(self._eval_task_done_callbacks)
                 for key, value in (exec_counters or {}).items():
                     self._exec_counters[key] = self._exec_counters.get(key, 0) + value
+                oov = (exec_counters or {}).get(
+                    TaskExecCounterKey.OOV_LOOKUP_COUNT, 0
+                )
+                if oov:
+                    # Loud in the master log (and on TensorBoard via the
+                    # progress sampler): OOV ids read zeros and receive
+                    # no update — at rate, the model is silently ignoring
+                    # features (docs/design.md migration rule).
+                    logger.warning(
+                        "Task %d saw %d out-of-vocabulary embedding ids "
+                        "(job total %d) — OOV ids read zeros and get no "
+                        "update; hash open-vocabulary features into "
+                        "fixed bins (preprocessing.Hashing)",
+                        task_id, oov,
+                        self._exec_counters[TaskExecCounterKey.OOV_LOOKUP_COUNT],
+                    )
             elif task.retry_count + 1 > self._max_task_retries:
                 logger.error(
                     "Task %d (%s[%d,%d)) exhausted %d retries; dropping",

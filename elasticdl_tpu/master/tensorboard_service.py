@@ -120,6 +120,17 @@ class TensorBoardService:
             )
             self.write_scalar("train/tasks_todo", counts["todo"], version)
             self.write_scalar("train/epoch", counts["epoch"], version)
+            from elasticdl_tpu.common.constants import TaskExecCounterKey
+
+            counters_fn = getattr(self._task_manager, "exec_counters", None)
+            if counters_fn is not None:
+                self.write_scalar(
+                    "train/oov_lookup_count",
+                    counters_fn().get(
+                        TaskExecCounterKey.OOV_LOOKUP_COUNT, 0
+                    ),
+                    version,
+                )
         if self._model_version_fn is not None:
             self.write_scalar("train/model_version", version, version)
         if self._restarts_fn is not None:

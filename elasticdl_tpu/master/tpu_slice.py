@@ -1,0 +1,91 @@
+"""TPU pod-slice topology for worker pod rendering (round-5 VERDICT #7).
+
+SURVEY §7 step 6: in the TPU deployment model one framework WORKER is one
+TPU VM HOST of a pod slice — the host's chips appear as local
+`jax.devices()`, the slice's ICI fabric carries the collectives, and
+`jax.distributed` (joined via the master rendezvous, parallel/elastic.py)
+stitches the hosts into one world.  k8s-side that means:
+
+- each worker pod requests the host's chips via the `google.com/tpu`
+  extension resource (the GKE TPU device plugin's resource name), and
+- node selectors pin the pod to nodes of the right accelerator type and
+  slice topology (`cloud.google.com/gke-tpu-accelerator` /
+  `cloud.google.com/gke-tpu-topology` — the GKE TPU node labels), and
+- `--num_workers` MUST equal the slice's host count: a pod slice is an
+  all-or-nothing unit, so under- or over-subscribing it deadlocks
+  scheduling or strands chips (validated at submit time, client/submit).
+
+Only rendering + validation lives here; scheduling is the cluster's job.
+Coordinator/port plumbing is the existing MY_POD_IP + master-rendezvous
+path (k8s_client._env_list, parallel/elastic.join_world) — TPU slices
+need nothing extra.
+
+The catalog covers the v5e (v5 lite) family this framework is tuned on;
+entries are (accelerator label, topology label, hosts, chips per host).
+The upstream reference has no TPU notion — its GPU workers request
+`nvidia.com/gpu` through the generic resource dict (SURVEY §2.1 pod
+manager), which `--worker_resource_request` still covers here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+
+@dataclass(frozen=True)
+class SliceSpec:
+    name: str
+    accelerator: str      # cloud.google.com/gke-tpu-accelerator value
+    topology: str         # cloud.google.com/gke-tpu-topology value
+    hosts: int            # worker pods required (one per TPU VM host)
+    chips_per_host: int   # google.com/tpu request per pod
+
+
+_V5E = "tpu-v5-lite-podslice"
+
+TPU_SLICES: Dict[str, SliceSpec] = {
+    spec.name: spec
+    for spec in (
+        # Single-host shapes (chips_per_host < 4 exist but the 4-chip
+        # host is the scheduling unit GKE exposes for podslices).
+        SliceSpec("v5e-4", _V5E, "2x2", 1, 4),
+        SliceSpec("v5e-8", _V5E, "2x4", 2, 4),
+        SliceSpec("v5e-16", _V5E, "4x4", 4, 4),
+        SliceSpec("v5e-32", _V5E, "4x8", 8, 4),
+        SliceSpec("v5e-64", _V5E, "8x8", 16, 4),
+        SliceSpec("v5e-128", _V5E, "8x16", 32, 4),
+        SliceSpec("v5e-256", _V5E, "16x16", 64, 4),
+    )
+}
+
+
+def slice_spec(name: str) -> SliceSpec:
+    try:
+        return TPU_SLICES[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown TPU slice {name!r}; known shapes: "
+            f"{', '.join(sorted(TPU_SLICES))}"
+        ) from None
+
+
+def worker_pod_overlay(spec: SliceSpec) -> Dict[str, Dict[str, str]]:
+    """What a worker pod of this slice adds to its manifest: the chip
+    resource request and the node selectors."""
+    return {
+        "resources": {"google.com/tpu": str(spec.chips_per_host)},
+        "node_selector": {
+            "cloud.google.com/gke-tpu-accelerator": spec.accelerator,
+            "cloud.google.com/gke-tpu-topology": spec.topology,
+        },
+    }
+
+
+def validate_worker_count(spec: SliceSpec, num_workers: int) -> None:
+    if num_workers != spec.hosts:
+        raise ValueError(
+            f"TPU slice {spec.name} has {spec.hosts} host(s); "
+            f"--num_workers={num_workers} must match (one worker per "
+            "TPU VM host — a pod slice schedules all-or-nothing)"
+        )

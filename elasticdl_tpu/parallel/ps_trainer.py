@@ -32,6 +32,7 @@ import optax
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.layers.embedding import (
     IDS_COLLECTION,
+    OOV_COLLECTION,
     PERTURBATIONS,
     SPECS_COLLECTION,
     VOCAB_AXIS,
@@ -45,6 +46,18 @@ from elasticdl_tpu.parallel.sparse_optim import SparseOptimizer, sgd
 from elasticdl_tpu.worker.trainer import _model_apply
 
 logger = get_logger("parallel.ps_trainer")
+
+# --sparse_apply_every=auto resolution (round-5 VERDICT #5): strict
+# per-step apply up to this many resident embedding rows, the windowed
+# W below above it.  Threshold = where strict mode's per-step
+# table-streaming pass starts dominating (the BASELINE.md table-scale
+# probe: ~3.5x at 26M rows) — deliberately the same number as
+# model_zoo/deepfm's SPLIT_TABLE_ROWS so a layout-aware model and the
+# trainer resolve `auto` consistently from the same row count.  W=32 is
+# the round-4 "largest safe W" (convergence within noise of strict at
+# both tested scales, BASELINE.md "Windowed-apply convergence").
+AUTO_APPLY_TABLE_ROWS = 10_000_000
+AUTO_APPLY_W = 32
 
 
 class PSTrainState(NamedTuple):
@@ -79,7 +92,7 @@ class ShardedEmbeddingTrainer:
         mesh,
         embedding_optimizer: Optional[SparseOptimizer] = None,
         seed: int = 0,
-        sparse_apply_every: int = 1,
+        sparse_apply_every=1,
     ):
         self._model = model
         self._loss_fn = loss_fn
@@ -92,12 +105,23 @@ class ShardedEmbeddingTrainer:
             )
             embedding_optimizer = sgd(0.01)
         self._emb_tx = embedding_optimizer
-        self._sparse_apply_every = max(1, int(sparse_apply_every))
+        if sparse_apply_every == "auto":
+            # Resolved at ensure_initialized, the first point the
+            # resident table row count is known (AUTO_APPLY_TABLE_ROWS
+            # below).  None means "unresolved"; consumers that peek
+            # before init (collective_worker window sizing) treat it as
+            # strict and re-sync after the trainer initializes.
+            self._sparse_apply_every = None
+        else:
+            self._sparse_apply_every = max(1, int(sparse_apply_every))
         self._mesh = mesh
         self._seed = seed
         self._dp = shd.data_axis_size(mesh)
         self._state: Optional[PSTrainState] = None
         self._host_step = 0
+        # Device-side OOV scalars, one per dispatched step/window; summed
+        # and drained host-side by consume_oov_count().
+        self._pending_oov: list = []
         self._perturb_shapes: Dict[str, Any] = {}
         self._pending_restore: Optional[PSTrainState] = None
         self._pending_sharded_restore: Optional[Tuple[Any, int]] = None
@@ -206,6 +230,7 @@ class ShardedEmbeddingTrainer:
         variables = dict(self._model.init(rng, features))
         params_boxed = variables.pop("params")
         variables.pop(IDS_COLLECTION, None)
+        variables.pop(OOV_COLLECTION, None)
         perturbs = variables.pop(PERTURBATIONS, {})
         specs_tree = variables.pop(SPECS_COLLECTION, {})
         model_state = variables
@@ -273,7 +298,19 @@ class ShardedEmbeddingTrainer:
         total_rows = sum(
             spec.vocab_size for spec in self._table_specs.values()
         )
-        if self._sparse_apply_every == 1 and total_rows > 10_000_000:
+        if self._sparse_apply_every is None:
+            self._sparse_apply_every = (
+                1 if total_rows <= AUTO_APPLY_TABLE_ROWS else AUTO_APPLY_W
+            )
+            logger.info(
+                "sparse_apply_every=auto -> %d (%.1fM resident embedding "
+                "rows %s the %dM strict/windowed threshold)",
+                self._sparse_apply_every,
+                total_rows / 1e6,
+                "<=" if total_rows <= AUTO_APPLY_TABLE_ROWS else ">",
+                AUTO_APPLY_TABLE_ROWS // 1_000_000,
+            )
+        if self._sparse_apply_every == 1 and total_rows > AUTO_APPLY_TABLE_ROWS:
             # Same honesty contract as the attention VMEM advice: strict
             # per-step apply at this scale pays table-sized streaming
             # passes every step — measured ~3x slower than the windowed
@@ -310,13 +347,13 @@ class ShardedEmbeddingTrainer:
         self._train_step = jax.jit(
             self._train_step_impl,
             in_shardings=(state_shardings, batch, batch, batch),
-            out_shardings=(state_shardings, repl),
+            out_shardings=(state_shardings, (repl, repl)),
             donate_argnums=(0,),
         )
         self._train_window = jax.jit(
             self._train_window_impl,
             in_shardings=(state_shardings, window, window, window),
-            out_shardings=(state_shardings, repl),
+            out_shardings=(state_shardings, (repl, repl)),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(
@@ -342,7 +379,9 @@ class ShardedEmbeddingTrainer:
     def _forward_backward(self, state: PSTrainState, features, labels, mask):
         """One fwd/bwd: loss, mutated collections, dense + perturbation
         (sparse embedding) gradients."""
-        mutable_keys = list(state.model_state.keys()) + [IDS_COLLECTION]
+        mutable_keys = list(state.model_state.keys()) + [
+            IDS_COLLECTION, OOV_COLLECTION,
+        ]
 
         def compute_loss(params, perturbs):
             full_params = self._merge_params(params, state.tables)
@@ -363,6 +402,15 @@ class ShardedEmbeddingTrainer:
             compute_loss, argnums=(0, 1), has_aux=True
         )(state.params, self._zero_perturbations())
         return loss, muts, dense_grads, perturb_grads
+
+    @staticmethod
+    def _oov_total(muts) -> jnp.ndarray:
+        """Sum of the per-Embedding OOV counts sown this apply (scalar
+        int32; zero when the model has no Embedding layers)."""
+        total = jnp.zeros((), jnp.int32)
+        for leaf in jax.tree.leaves(muts.get(OOV_COLLECTION, {})):
+            total = total + jnp.sum(jnp.asarray(leaf))
+        return total
 
     def _sparse_batches(self, muts, perturb_grads, tables):
         """Per table: (spec, flat ids, flat grads) from the sown id
@@ -413,7 +461,7 @@ class ShardedEmbeddingTrainer:
                 new_tables,
                 new_slots,
             ),
-            loss,
+            (loss, self._oov_total(muts)),
         )
 
     def _train_chunk_impl(self, state: PSTrainState, feats, labels, masks):
@@ -459,9 +507,9 @@ class ShardedEmbeddingTrainer:
                 st.step + 1, new_params, new_opt_state, new_model_state,
                 st.tables, st.slots,
             )
-            return new_st, (loss, sparse)
+            return new_st, (loss, self._oov_total(muts), sparse)
 
-        state, (losses, sparse) = jax.lax.scan(
+        state, (losses, oovs, sparse) = jax.lax.scan(
             body, state, (feats, labels, masks)
         )
         new_tables = dict(state.tables)
@@ -474,7 +522,10 @@ class ShardedEmbeddingTrainer:
                 ids_w.reshape((-1,)),
                 grads_w.reshape((-1, spec.dim)),
             )
-        return state._replace(tables=new_tables, slots=new_slots), losses
+        return (
+            state._replace(tables=new_tables, slots=new_slots),
+            (losses, jnp.sum(oovs)),
+        )
 
     def _train_window_impl(self, state, feat_win, label_win, mask_win):
         """K train steps in ONE device program (lax.scan over the stacked
@@ -482,21 +533,25 @@ class ShardedEmbeddingTrainer:
         K-fold — the TPU-idiomatic device-side training loop.  With
         sparse_apply_every=W > 1 the window runs as ceil(K/W) chunks (see
         _train_chunk_impl)."""
-        W = self._sparse_apply_every
+        W = self._sparse_apply_every or 1  # auto resolves at init
 
         if W <= 1:
             def body(st, xs):
                 features, labels, mask = xs
-                new_state, loss = self._train_step_impl(
+                new_state, (loss, oov) = self._train_step_impl(
                     st, features, labels, mask
                 )
-                return new_state, loss
+                return new_state, (loss, oov)
 
-            return jax.lax.scan(body, state, (feat_win, label_win, mask_win))
+            state, (losses, oovs) = jax.lax.scan(
+                body, state, (feat_win, label_win, mask_win)
+            )
+            return state, (losses, jnp.sum(oovs))
 
         K = jax.tree.leaves(feat_win)[0].shape[0]
         n_full, rem = divmod(K, W)
         losses_parts = []
+        oov_parts = []
         if n_full:
             chunked = jax.tree.map(
                 lambda x: x[: n_full * W].reshape(
@@ -508,20 +563,26 @@ class ShardedEmbeddingTrainer:
             def chunk_body(st, xs):
                 return self._train_chunk_impl(st, *xs)
 
-            state, losses_full = jax.lax.scan(chunk_body, state, chunked)
+            state, (losses_full, oov_full) = jax.lax.scan(
+                chunk_body, state, chunked
+            )
             losses_parts.append(losses_full.reshape((-1,)))
+            oov_parts.append(jnp.sum(oov_full))
         if rem:
             tail = jax.tree.map(
                 lambda x: x[n_full * W:], (feat_win, label_win, mask_win)
             )
-            state, losses_tail = self._train_chunk_impl(state, *tail)
+            state, (losses_tail, oov_tail) = self._train_chunk_impl(
+                state, *tail
+            )
             losses_parts.append(losses_tail)
+            oov_parts.append(oov_tail)
         losses = (
             jnp.concatenate(losses_parts)
             if len(losses_parts) > 1
             else losses_parts[0]
         )
-        return state, losses
+        return state, (losses, sum(oov_parts))
 
     def _eval_step_impl(self, state: PSTrainState, features):
         variables = {
@@ -570,8 +631,9 @@ class ShardedEmbeddingTrainer:
             raise RuntimeError(
                 "train_step_staged requires ensure_initialized(features) first"
             )
-        self._state, loss = self._train_step(self._state, *staged)
+        self._state, (loss, oov) = self._train_step(self._state, *staged)
         self._host_step += 1
+        self._pending_oov.append(oov)
         return loss
 
     def stage_window(self, batches):
@@ -596,9 +658,21 @@ class ShardedEmbeddingTrainer:
                 "train_window requires ensure_initialized(features) first"
             )
         k = jax.tree.leaves(window[1])[0].shape[0]
-        self._state, losses = self._train_window(self._state, *window)
+        self._state, (losses, oov) = self._train_window(self._state, *window)
         self._host_step += k
+        self._pending_oov.append(oov)
         return losses
+
+    def consume_oov_count(self) -> int:
+        """Total out-of-vocabulary ids seen by train steps since the last
+        call.  BLOCKS on the pending device scalars — call at task
+        boundaries (the worker does, folding the count into the task's
+        exec counters), not in the dispatch hot loop."""
+        if not self._pending_oov:
+            return 0
+        total = sum(int(np.asarray(x)) for x in self._pending_oov)
+        self._pending_oov = []
+        return total
 
     def eval_step(self, features):
         n = jax.tree.leaves(features)[0].shape[0]

@@ -83,23 +83,12 @@ class CollectiveWorker:
         # strictly.  A window smaller than the apply interval silently
         # halves (or worse) the promised amortization, so grow an EXPLICIT
         # window to a multiple and say so (auto windows round themselves).
+        # `auto` apply mode resolves inside the trainer at init (table
+        # rows unknown until then) — reads 1 here and re-syncs via
+        # _sync_apply_every() right after ensure_initialized, before
+        # anything compiles.
         self._apply_every = int(getattr(trainer, "_sparse_apply_every", 1) or 1)
-        if (
-            self._window_steps
-            and self._apply_every > 1
-            and self._window_steps % self._apply_every
-        ):
-            grown = (
-                -(-self._window_steps // self._apply_every)
-                * self._apply_every
-            )
-            logger.warning(
-                "Dispatch window %d is not a multiple of "
-                "sparse_apply_every=%d; growing the window to %d so every "
-                "chunk reaches the configured apply interval",
-                self._window_steps, self._apply_every, grown,
-            )
-            self._window_steps = grown
+        self._grow_explicit_window_to_apply_multiple()
         # Pinned from the first task (standard task size) so the job
         # compiles ONE fused-scan executable; smaller (tail) tasks fall
         # back to the already-compiled per-step program instead of
@@ -351,6 +340,40 @@ class CollectiveWorker:
     AUTO_WINDOW_STEPS = 400
     AUTO_WINDOW_BYTES = 1 << 30
 
+    def _grow_explicit_window_to_apply_multiple(self) -> None:
+        """An explicit window that is not a multiple of the apply interval
+        silently halves (or worse) the promised amortization — grow it and
+        say so (auto windows round themselves in _window_candidate)."""
+        if (
+            self._window_steps
+            and self._apply_every > 1
+            and self._window_steps % self._apply_every
+        ):
+            grown = (
+                -(-self._window_steps // self._apply_every)
+                * self._apply_every
+            )
+            logger.warning(
+                "Dispatch window %d is not a multiple of "
+                "sparse_apply_every=%d; growing the window to %d so every "
+                "chunk reaches the configured apply interval",
+                self._window_steps, self._apply_every, grown,
+            )
+            self._window_steps = grown
+
+    def _sync_apply_every(self) -> bool:
+        """Re-read the trainer's (possibly auto-resolved) apply interval;
+        True if it changed.  Called once right after ensure_initialized —
+        nothing has compiled yet, so window sizing may still move."""
+        resolved = int(
+            getattr(self._trainer, "_sparse_apply_every", 1) or 1
+        )
+        if resolved == self._apply_every:
+            return False
+        self._apply_every = resolved
+        self._grow_explicit_window_to_apply_multiple()
+        return True
+
     def _window_candidate(self, task_batches: int) -> int:
         explicit = self._window_steps
         cand = min(explicit or self.AUTO_WINDOW_STEPS, task_batches)
@@ -448,21 +471,30 @@ class CollectiveWorker:
         ):
             self._trainer.ensure_initialized(features)
             if self._batch_nbytes is None:
-                # One-time downward refinement of an AUTO window from the
-                # real staged-batch size, before anything has compiled.
+                # One-time refinement of the window from the real
+                # staged-batch size AND the trainer's now-resolved apply
+                # interval (--sparse_apply_every=auto resolves at init),
+                # before anything has compiled.  Byte refinement only
+                # shrinks; an auto-resolved interval may also GROW an
+                # explicit window to a chunk multiple.
+                apply_changed = self._sync_apply_every()
                 self._batch_nbytes = sum(
                     np.asarray(leaf).nbytes
                     for leaf in jax.tree.leaves((features, labels, mask))
                 )
                 refined = self._window_candidate(task_batches)
-                if refined < window_steps:
+                if refined < window_steps or (
+                    apply_changed and refined != window_steps
+                ):
                     if self._world.is_leader:
                         logger.info(
                             "Dispatch window %d -> %d (staged batch is "
-                            "%.1f MB; %d MB auto cap)",
+                            "%.1f MB, %d MB auto cap; "
+                            "sparse_apply_every=%d)",
                             window_steps, refined,
                             self._batch_nbytes / 2**20,
                             self.AUTO_WINDOW_BYTES >> 20,
+                            self._apply_every,
                         )
                     window_steps = refined
                     self._effective_window = refined
@@ -480,10 +512,18 @@ class CollectiveWorker:
                 batch_count,
             )
         self._report_version()
-        return {
+        counters = {
             TaskExecCounterKey.BATCH_COUNT: batch_count,
             TaskExecCounterKey.RECORD_COUNT: record_count,
         }
+        consume_oov = getattr(self._trainer, "consume_oov_count", None)
+        if consume_oov is not None:
+            # Task boundary — the one place a device sync is already paid
+            # (the task-done log above materialized the last loss).
+            oov = consume_oov()
+            if oov:
+                counters[TaskExecCounterKey.OOV_LOOKUP_COUNT] = oov
+        return counters
 
     # Leader-side eval outputs flush cadence: bounds the accumulated
     # (outputs, labels) to EVAL_REPORT_BATCHES x global-batch regardless

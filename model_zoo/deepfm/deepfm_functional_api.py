@@ -117,11 +117,32 @@ def custom_model(
     embedding_dim: int = 8,
     hidden: int = 128,
     split_tables: bool | None = None,
-    sparse_apply_every: int = 1,
+    sparse_apply_every: "int | str" = 1,
 ):
     """`sparse_apply_every` arrives from the job flag (model_utils
     forwards it to models declaring the parameter) and drives the auto
-    table layout; `--model_params split_tables=...` overrides."""
+    table layout; `--model_params split_tables=...` overrides.  The
+    flag's 'auto' resolves here from the model's own vocabulary using
+    the SAME threshold the trainer resolves with at init
+    (ps_trainer.AUTO_APPLY_TABLE_ROWS == SPLIT_TABLE_ROWS), so layout
+    and apply mode can't diverge: auto at <=10M rows is strict+merged,
+    above it windowed+merged — auto never reaches the strict-large
+    regime the split layout exists for."""
+    if sparse_apply_every == "auto":
+        from elasticdl_tpu.parallel.ps_trainer import (
+            AUTO_APPLY_TABLE_ROWS,
+            AUTO_APPLY_W,
+        )
+
+        # Count the rows the TRAINER will count: it sums rows over the
+        # actual tables at init, so a forced split layout
+        # (--model_params split_tables=true) holds 2x total_vocab rows
+        # (linear + fm).  Resolving from the same count keeps layout
+        # and apply mode consistent in every configuration.
+        total_rows = vocab_size * NUM_CAT * (2 if split_tables else 1)
+        sparse_apply_every = (
+            1 if total_rows <= AUTO_APPLY_TABLE_ROWS else AUTO_APPLY_W
+        )
     return DeepFM(
         vocab_size=vocab_size,
         embedding_dim=embedding_dim,
@@ -162,10 +183,12 @@ def dataset_fn(dataset, mode, metadata):
     return dataset
 
 
-def columnar_dataset_fn(columns, mode, metadata):
+def columnar_dataset_fn(columns, mode, metadata, seed: int = 0):
     """Vectorized counterpart of dataset_fn for the columnar task path
     (data/columnar.py): whole-column casts + one deterministic
-    permutation instead of per-record map + buffered shuffle."""
+    permutation instead of per-record map + buffered shuffle.  `seed`
+    arrives task/epoch-derived (same on every rank) so the shuffle
+    order varies across epochs instead of replaying."""
     from elasticdl_tpu.data.columnar import training_permutation
 
     features = {
@@ -174,7 +197,7 @@ def columnar_dataset_fn(columns, mode, metadata):
     }
     labels = columns["label"][:, 0].astype(np.int32)
     if mode == "training":
-        perm = training_permutation(len(labels), seed=0)
+        perm = training_permutation(len(labels), seed=seed)
         features = {k: v[perm] for k, v in features.items()}
         labels = labels[perm]
     return features, labels

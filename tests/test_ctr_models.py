@@ -106,3 +106,28 @@ def test_deepfm_auto_layout_selection():
     assert windowed_big._split(big_vocab * zoo.NUM_CAT) is False
     forced = zoo.custom_model(vocab_size=100, split_tables=True)
     assert forced._split(100 * zoo.NUM_CAT) is True
+    # 'auto' resolves inside custom_model from the model's own vocab,
+    # with the trainer's threshold: strict+merged small, windowed+merged
+    # big — auto never reaches the strict-large split regime.
+    auto_small = zoo.custom_model(vocab_size=100, sparse_apply_every="auto")
+    assert auto_small.sparse_apply_every == 1
+    assert auto_small._split(100 * zoo.NUM_CAT) is False
+    auto_big = zoo.custom_model(
+        vocab_size=big_vocab, sparse_apply_every="auto"
+    )
+    from elasticdl_tpu.parallel.ps_trainer import AUTO_APPLY_W
+
+    assert auto_big.sparse_apply_every == AUTO_APPLY_W
+    assert auto_big._split(big_vocab * zoo.NUM_CAT) is False
+    # Forced split layout doubles the resident rows (linear + fm), and
+    # auto resolves from the SAME count the trainer will see at init —
+    # half the threshold vocab crosses into windowed when split.
+    half_vocab = zoo.SPLIT_TABLE_ROWS // (2 * zoo.NUM_CAT) + 1
+    auto_split = zoo.custom_model(
+        vocab_size=half_vocab, split_tables=True, sparse_apply_every="auto"
+    )
+    assert auto_split.sparse_apply_every == AUTO_APPLY_W
+    auto_merged = zoo.custom_model(
+        vocab_size=half_vocab, sparse_apply_every="auto"
+    )
+    assert auto_merged.sparse_apply_every == 1

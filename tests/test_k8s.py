@@ -525,3 +525,75 @@ def test_submit_rejects_elastic_job_without_shared_checkpoint(client):
     with pytest.raises(ValueError, match="checkpoint_dir"):
         submit_job(args, Mode.TRAINING, k8s_client=client)
     assert client.list_pods() == []
+
+
+def test_tpu_slice_worker_pods_rendered(client, fake_k8s):
+    """--tpu_slice=v5e-16 (round-5 VERDICT #7): one worker pod per TPU VM
+    host — 4 pods, each requesting the host's 4 chips via google.com/tpu
+    and pinned to the slice's accelerator/topology node labels, with the
+    MY_POD_IP coordinator plumbing intact."""
+    manager, _ = _manager(client, fake_k8s, n=4, tpu_slice="v5e-16")
+    manager._substrate_launch([0, 1, 2, 3])
+    pods = client.list_pods(job_label_selector("testjob", "worker"))
+    assert len(pods) == 4
+    for pod in pods:
+        res = pod["spec"]["containers"][0]["resources"]
+        assert res["requests"]["google.com/tpu"] == "4"
+        assert res["limits"]["google.com/tpu"] == "4"
+        sel = pod["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == (
+            "tpu-v5-lite-podslice"
+        )
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+        env_names = {
+            e["name"] for e in pod["spec"]["containers"][0]["env"]
+        }
+        # Workers advertise their pod IP to the master rendezvous; the
+        # jax.distributed coordinator address resolves from it.
+        assert "MY_POD_IP" in env_names
+
+
+def test_tpu_slice_explicit_resources_merge(client, fake_k8s):
+    """--worker_resource_request composes with the slice overlay (cpu and
+    memory requests ride alongside the chip request)."""
+    manager, _ = _manager(
+        client, fake_k8s, n=2, tpu_slice="v5e-8",
+        worker_resources={"memory": "100Gi"},
+    )
+    manager._substrate_launch([0])
+    (pod,) = client.list_pods(job_label_selector("testjob", "worker"))
+    requests = pod["spec"]["containers"][0]["resources"]["requests"]
+    assert requests == {"memory": "100Gi", "google.com/tpu": "4"}
+    assert pod["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-topology"
+    ] == "2x4"
+
+
+def test_tpu_slice_validation():
+    """Wrong worker count or unknown shape fails loudly — at manager
+    construction in-cluster and at submit time client-side."""
+    from elasticdl_tpu.client.submit import validate_cluster_args
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.constants import Mode
+    from elasticdl_tpu.master.tpu_slice import slice_spec
+
+    with pytest.raises(ValueError, match="4 host"):
+        from elasticdl_tpu.master.tpu_slice import validate_worker_count
+
+        validate_worker_count(slice_spec("v5e-16"), 3)
+    with pytest.raises(ValueError, match="known shapes"):
+        slice_spec("v9z-1")
+
+    args = parse_master_args(
+        [
+            "--job_name=tpujob",
+            "--image_name=elasticdl:test",
+            "--model_zoo=/zoo",
+            "--model_def=m.f",
+            "--training_data=/data",
+            "--num_workers=3",
+            "--tpu_slice=v5e-16",
+        ]
+    )
+    with pytest.raises(ValueError, match="num_workers"):
+        validate_cluster_args(args, Mode.TRAINING)

@@ -163,7 +163,13 @@ def test_format_dict_params_round_trip():
     params = {"vocab_size": 100, "use_bf16": True, "lr": 0.5,
               "mode": "auto", "split_tables": False}
     assert parse_dict_params(format_dict_params(params)) == params
+    # '=' inside a string value round-trips (parse splits items on ','
+    # then on the FIRST '=') — a URL-valued param must not abort the
+    # end-of-training export (round-4 ADVICE).
+    url_params = {"init_from": "gs://bkt/ckpt?ver=3", "vocab_size": 7}
+    assert parse_dict_params(format_dict_params(url_params)) == url_params
     import pytest as _pytest
 
+    # ',' is genuinely non-round-trippable: it splits the item list.
     with _pytest.raises(ValueError):
-        format_dict_params({"bad": "a=b"})
+        format_dict_params({"bad": "a,b"})

@@ -296,6 +296,36 @@ class TestRetryBudget:
             manager.report(task.task_id, True, 0, exec_counters={"batch_count": 5})
         assert manager.exec_counters() == {"batch_count": 10}
 
+    def test_oov_counter_reaches_master_and_warns(self):
+        """A task report carrying oov_lookup_count aggregates like any exec
+        counter AND raises a master-log warning — the production alarm
+        path for the fixed-vocab OOV contract (docs/design.md)."""
+        import io
+        import logging
+
+        from elasticdl_tpu.common.constants import TaskExecCounterKey
+
+        manager = TaskManager(training_shards={"x": 20}, records_per_task=10)
+        stream = io.StringIO()
+        handler = logging.StreamHandler(stream)
+        logging.getLogger("elasticdl_tpu.master.task_manager").addHandler(
+            handler
+        )
+        try:
+            task = manager.get(0)
+            manager.report(
+                task.task_id, True, 0,
+                exec_counters={TaskExecCounterKey.OOV_LOOKUP_COUNT: 42},
+            )
+        finally:
+            logging.getLogger(
+                "elasticdl_tpu.master.task_manager"
+            ).removeHandler(handler)
+        assert manager.exec_counters()[
+            TaskExecCounterKey.OOV_LOOKUP_COUNT
+        ] == 42
+        assert "out-of-vocabulary" in stream.getvalue()
+
 
 class TestFinalizationRace:
     def test_second_worker_waits_during_done_callbacks(self):
