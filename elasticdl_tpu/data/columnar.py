@@ -22,6 +22,7 @@ unchanged (reference-parity behaviour).
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -86,7 +87,19 @@ def materialize_columnar_task(
         columns = {
             k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]
         }
-    features, labels = columnar_dataset_fn(columns, mode, metadata)
+    kwargs = {}
+    if "seed" in inspect.signature(columnar_dataset_fn).parameters:
+        # Task-identity-derived randomness for transforms that opt in
+        # (shuffle order, image crop/flip): deterministic across ranks
+        # (every rank sees identical task fields — lockstep collectives
+        # require it) but VARIES across tasks and epochs — a fixed seed
+        # would replay bit-identical augmentation every epoch.
+        kwargs["seed"] = (
+            1_000_003 * int(getattr(task, "epoch", 0))
+            + 31 * int(getattr(task, "start", 0))
+            + int(getattr(task, "end", 0))
+        ) % (2**31)
+    features, labels = columnar_dataset_fn(columns, mode, metadata, **kwargs)
     return ColumnarTask(features, labels)
 
 

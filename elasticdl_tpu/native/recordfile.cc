@@ -35,9 +35,13 @@ thread_local std::string g_last_error;
 
 void set_error(const std::string& message) { g_last_error = message; }
 
-// zlib-compatible CRC-32 (polynomial 0xEDB88320), table-driven.
-const uint32_t* crc_table() {
-  static uint32_t table[256];
+// zlib-compatible CRC-32 (polynomial 0xEDB88320), slicing-by-8: eight
+// derived tables let the hot loop fold 8 bytes per iteration (~5-6x the
+// classic byte-at-a-time table walk).  The byte loop capped the record
+// read path at ~300 MB/s, which for 150 KB image records (round-5 image
+// data plane) made CRC the whole data-plane bottleneck.
+const uint32_t (*crc_tables())[256] {
+  static uint32_t tables[8][256];
   static bool initialized = false;
   if (!initialized) {
     for (uint32_t i = 0; i < 256; ++i) {
@@ -45,18 +49,36 @@ const uint32_t* crc_table() {
       for (int k = 0; k < 8; ++k) {
         c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
       }
-      table[i] = c;
+      tables[0][i] = c;
+    }
+    for (int t = 1; t < 8; ++t) {
+      for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = tables[t - 1][i];
+        tables[t][i] = tables[0][c & 0xFF] ^ (c >> 8);
+      }
     }
     initialized = true;
   }
-  return table;
+  return tables;
 }
 
 uint32_t crc32(const uint8_t* data, size_t len) {
-  const uint32_t* table = crc_table();
+  const uint32_t (*t)[256] = crc_tables();
   uint32_t c = 0xFFFFFFFFu;
+  while (len >= 8) {
+    uint32_t lo;
+    uint32_t hi;
+    std::memcpy(&lo, data, 4);      // little-endian loads (x86/arm LE)
+    std::memcpy(&hi, data + 4, 4);
+    c ^= lo;
+    c = t[7][c & 0xFF] ^ t[6][(c >> 8) & 0xFF] ^ t[5][(c >> 16) & 0xFF] ^
+        t[4][c >> 24] ^ t[3][hi & 0xFF] ^ t[2][(hi >> 8) & 0xFF] ^
+        t[1][(hi >> 16) & 0xFF] ^ t[0][hi >> 24];
+    data += 8;
+    len -= 8;
+  }
   for (size_t i = 0; i < len; ++i) {
-    c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    c = t[0][(c ^ data[i]) & 0xFF] ^ (c >> 8);
   }
   return c ^ 0xFFFFFFFFu;
 }

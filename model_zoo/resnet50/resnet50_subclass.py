@@ -70,11 +70,24 @@ class BottleneckBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+# ImageNet channel statistics on the 0-255 uint8 scale (device-side
+# normalization — see ResNet50.normalize).
+IMAGENET_MEAN = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+IMAGENET_STD = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+
+
 class ResNet50(nn.Module):
     num_classes: int = NUM_CLASSES
     dtype: Dtype = jnp.bfloat16
     norm_dtype: Dtype = jnp.float32
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    # Device-side input normalization (round 5): the host stages RAW
+    # uint8 pixels — half the host->device bytes of bf16, a quarter of
+    # f32, and no per-pixel float math on the host — and the
+    # (x - mean)/std here runs in compute dtype, fusing into the first
+    # conv's input cast (XLA; cost is one elementwise pass the input
+    # read already pays).  Inputs are expected on the 0-255 scale.
+    normalize: bool = True
     # Stem note: the standard TPU space-to-depth transform (fold 2x2
     # patches -> [B,112,112,12], 4x4 unstrided conv) was MEASURED on the
     # v5e in round 3 and LOST: 2,102 img/s vs 2,665 for the plain 7x7/s2
@@ -86,6 +99,10 @@ class ResNet50(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
+        if self.normalize:
+            mean = jnp.asarray(IMAGENET_MEAN, self.dtype)
+            std = jnp.asarray(IMAGENET_STD, self.dtype)
+            x = (x - mean) / std
         x = nn.Conv(
             64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
             use_bias=False, dtype=self.dtype,
@@ -127,13 +144,11 @@ def optimizer(lr: float = 0.1):
 
 
 def dataset_fn(dataset, mode, metadata):
-    mean = np.asarray([0.485, 0.456, 0.406], np.float32)
-    std = np.asarray([0.229, 0.224, 0.225], np.float32)
-
+    # The host stays in uint8: normalization happens on device (the
+    # model's `normalize` head), so parse is shape/type assembly only.
     def parse(record):
         image, label = record
-        image = (np.asarray(image, np.float32) / 255.0 - mean) / std
-        return image, np.int32(label)
+        return np.asarray(image, np.uint8), np.int32(label)
 
     dataset = dataset.map(parse)
     if mode == "training":
@@ -152,13 +167,117 @@ def eval_metrics_fn():
     }
 
 
+# Stored record size for the ETRF image plane: images are packed
+# slightly larger than the train crop (the record-cache equivalent of
+# ImageNet's train-time crop jitter); random_crop_flip takes 256 -> 224.
+IMAGE_STORE_SIZE = 256
+
+
+def columnar_dataset_fn(columns, mode, metadata, seed: int = 0):
+    """Vectorized counterpart of dataset_fn for the columnar task path:
+    the ETRF buffer parse hands [n, S*S*3] uint8 rows; reshape is a
+    view, training applies one permutation + the uint8 crop/flip
+    augmentation (elasticdl_tpu/data/image.py) for the whole task, eval
+    center-crops deterministically.  Everything stays uint8 — the model
+    normalizes on device.  `seed` arrives task/epoch-derived from
+    materialize_columnar_task (identical on every rank, different per
+    task and epoch) so crops/flips don't replay bit-identically across
+    epochs."""
+    from elasticdl_tpu.data import image as image_plane
+
+    flat = columns["image"]
+    n = len(flat)
+    size = int(round((flat.shape[1] // 3) ** 0.5))
+    images = flat.reshape((n, size, size, 3))
+    labels = columns["label"][:, 0].astype(np.int32)
+    # Records smaller than the train size pass through at their own
+    # size (the architecture is size-agnostic; tiny CI fixtures rely on
+    # this) — production 256-records crop to 224.
+    crop = min(IMAGE_SIZE, size)
+    if mode == "training":
+        from elasticdl_tpu.data.columnar import training_permutation
+
+        perm = training_permutation(n, seed=seed)
+        # The permutation rides the crop's per-sample gather (`order=`)
+        # — a separate images[perm] pass would copy the full stored-size
+        # array (hundreds of MB per task) just to reorder it.
+        images = image_plane.random_crop_flip(
+            images, crop, np.random.default_rng(seed), order=perm
+        )
+        labels = labels[perm]
+    elif size != crop:
+        images = image_plane.center_crop(images, crop)
+    return images, labels
+
+
+class ImageRecordReader(datasets.AbstractDataReader):
+    """Shard-addressable reader over an image-ETRF file (fixed-size
+    uint8 records, data/image.py layout) using the vectorized buffer
+    path — the vision twin of deepfm's CriteoRecordReader, so the
+    collective worker's task pipeline (shards, columnar fast path,
+    per-record fallback) works unchanged."""
+
+    def __init__(self, path: str, size: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self._path = path
+        # Self-describing: the fixed record width encodes the stored
+        # image size (S*S*3 + 4 label bytes), so readers on any host
+        # (cluster worker pods included) need no side-channel config.
+        self._size = size or self._infer_size(path)
+        from elasticdl_tpu.data.image import image_record_layout
+
+        self._layout = image_record_layout(self._size)
+
+    @staticmethod
+    def _infer_size(path: str) -> int:
+        from elasticdl_tpu.data import recordfile
+
+        first = next(iter(recordfile.read_range(path, 0, 1)))
+        size = int(round(((len(first) - 4) // 3) ** 0.5))
+        if size * size * 3 + 4 != len(first):
+            raise ValueError(
+                f"{path}: {len(first)}B records are not square uint8 "
+                "HWC images + int32 label (data/image.py layout)"
+            )
+        return size
+
+    def create_shards(self):
+        from elasticdl_tpu.data import recordfile
+
+        return {self._path: recordfile.count_records(self._path)}
+
+    def read_records(self, task):
+        s = self._size
+        for cols in self.read_columns(task):
+            images, label = cols["image"], cols["label"]
+            for i in range(len(label)):
+                yield (
+                    images[i].reshape((s, s, 3)),
+                    np.int32(label[i, 0]),
+                )
+
+    def read_columns(self, task):
+        from elasticdl_tpu.data import recordfile
+
+        for buf, lengths in recordfile.read_range_buffers(
+            self._path, task.start, task.end
+        ):
+            # copy=False: image columns go straight into the crop's
+            # gather (columnar_dataset_fn), so the defensive copy would
+            # be a wasted full pass over ~150 KB/record.
+            yield self._layout.parse_buffer(buf, lengths, copy=False)
+
+
 def custom_data_reader(data_path: str, **kwargs):
     name, params = datasets.parse_synthetic_path(data_path)
-    if name is None:
-        return None
-    return datasets.synthetic_imagenet_reader(
-        n=params.get("n", 1024),
-        seed=params.get("seed", 0),
-        image_size=params.get("size", IMAGE_SIZE),
-        num_classes=params.get("classes", NUM_CLASSES),
-    )
+    if name is not None:
+        return datasets.synthetic_imagenet_reader(
+            n=params.get("n", 1024),
+            seed=params.get("seed", 0),
+            image_size=params.get("size", IMAGE_SIZE),
+            num_classes=params.get("classes", NUM_CLASSES),
+        )
+    path = data_path.removeprefix("recordio:")
+    if path.endswith(".etrf"):
+        return ImageRecordReader(path, **kwargs)
+    return None

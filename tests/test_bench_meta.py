@@ -35,7 +35,8 @@ def test_roofline_fields_every_tracked_metric():
         assert fields, f"no roofline fields for {metric}"
         fracs = [
             v for k, v in fields.items()
-            if k in ("mfu", "bw_frac", "floor_frac", "host_parse_frac")
+            if k in ("mfu", "bw_frac", "floor_frac", "host_parse_frac",
+                     "device_frac")
         ]
         assert fracs, f"no fraction field for {metric}: {fields}"
         for frac in fracs:
@@ -60,6 +61,65 @@ def test_emit_json_contract(capsys):
     assert row["tracked"] is False
     assert 0 < row["mfu"] < 1
     assert row["vs_baseline"] == pytest.approx(242_000.0 / 241_046.0, rel=1e-3)
+
+
+def test_final_emit_carries_every_metric(capsys):
+    """The driver's BENCH_r{N}.json preserves only the parsed FINAL line;
+    final=True must fold every previously emitted row into `all` so the
+    artifact alone reconstructs the round (VERDICT round-4 weak #1)."""
+    bench._EMITTED.clear()
+    bench._emit(
+        "resnet50_images_per_sec_per_chip", 2_665.0, "images/sec/chip",
+        0.01,
+    )
+    bench._emit(
+        "deepfm_26m_strict_samples_per_sec_per_chip", 272_953.0,
+        "samples/sec/chip", 0.01,
+    )
+    bench._emit(
+        "deepfm_train_samples_per_sec_per_chip", 975_000.0,
+        "samples/sec/chip", 0.001, final=True,
+    )
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert "all" not in json.loads(lines[0])
+    final = json.loads(lines[-1])
+    assert set(final["all"]) == {
+        "resnet50_images_per_sec_per_chip",
+        "deepfm_26m_strict_samples_per_sec_per_chip",
+        "deepfm_train_samples_per_sec_per_chip",
+    }
+    resnet = final["all"]["resnet50_images_per_sec_per_chip"]
+    assert resnet["value"] == 2_665.0
+    assert resnet["unit"] == "images/sec/chip"
+    assert "vs_baseline" in resnet and "spread" in resnet
+    strict = final["all"]["deepfm_26m_strict_samples_per_sec_per_chip"]
+    assert strict["bound"] == "table-stream"
+    # The headline row itself is in `all` too — one artifact, whole round.
+    assert final["all"]["deepfm_train_samples_per_sec_per_chip"][
+        "value"
+    ] == final["value"]
+    bench._EMITTED.clear()
+
+
+def test_ring_roofline_reads_ring_bench_config():
+    """_roofline_fields' ring FLOP accounting must follow RING_BENCH (the
+    dict bench_ring_engine also reads) — a divergent copy would silently
+    emit a wrong mfu (round-4 ADVICE)."""
+    base = bench._roofline_fields(
+        "ring_attention_tokens_per_sec_per_chip", 1_977_558.0
+    )
+    orig = dict(bench.RING_BENCH)
+    try:
+        bench.RING_BENCH["t_local"] = orig["t_local"] * 2
+        doubled = bench._roofline_fields(
+            "ring_attention_tokens_per_sec_per_chip", 1_977_558.0
+        )
+    finally:
+        bench.RING_BENCH.clear()
+        bench.RING_BENCH.update(orig)
+    # FLOPs/group scale with t_local^2 but tokens/group only with
+    # t_local -> achieved flops at fixed token rate doubles.
+    assert doubled["mfu"] == pytest.approx(2 * base["mfu"], rel=0.02)
 
 
 def test_ring_bench_harness_import():

@@ -93,11 +93,24 @@ def test_columnar_training_is_deterministic_permutation(tmp_path):
     ordered = materialize_columnar_task(
         reader, task, zoo.columnar_dataset_fn, "evaluation", None
     )
-    perm = training_permutation(200, seed=0)
+    # The shuffle seed is TASK-DERIVED (identical on every rank, but
+    # varying across tasks/epochs — round-5 review fix: a fixed seed
+    # replayed the same order every epoch).
+    seed = (31 * task.start + task.end) % (2**31)
+    perm = training_permutation(200, seed=seed)
     np.testing.assert_array_equal(
         a.features["cat"], ordered.features["cat"][perm]
     )
     np.testing.assert_array_equal(a.labels, ordered.labels[perm])
+
+    # A later epoch of the same range shuffles DIFFERENTLY.
+    class _EpochTask:
+        start, end, epoch = 0, 200, 1
+
+    later = materialize_columnar_task(
+        reader, _EpochTask, zoo.columnar_dataset_fn, "training", None
+    )
+    assert not np.array_equal(a.features["cat"], later.features["cat"])
 
 
 def test_columnar_falls_back_without_surface(tmp_path):

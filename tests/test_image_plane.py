@@ -1,0 +1,123 @@
+"""Image data plane (round-5 VERDICT #1): ETRF-packed uint8 images,
+vectorized parse, host augmentation, and ResNet-50 training from files
+through the task pipeline — the vision twin of the DeepFM record plane.
+
+Parity surface: SURVEY §2.2 data readers + §3.3 worker dataset assembly
+(†elasticdl/python/data/reader/, †task_data_service.py) for the vision
+configs.
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data import image as image_plane
+from model_zoo.resnet50 import resnet50_subclass as zoo
+
+
+def _synthetic_images(n, size, classes=8, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, size=(n, size, size, 3)).astype(np.uint8)
+    labels = rng.integers(0, classes, size=n).astype(np.int32)
+    return images, labels
+
+
+def test_etrf_image_roundtrip(tmp_path):
+    path = str(tmp_path / "img.etrf")
+    images, labels = _synthetic_images(12, 20)
+    image_plane.write_image_etrf(path, images, labels)
+
+    reader = zoo.ImageRecordReader(path)
+    assert reader._size == 20  # inferred from the record width
+    assert reader.create_shards() == {path: 12}
+
+    class _Task:
+        start, end = 3, 9
+
+    cols = next(iter(reader.read_columns(_Task)))
+    np.testing.assert_array_equal(
+        cols["image"].reshape((6, 20, 20, 3)), images[3:9]
+    )
+    np.testing.assert_array_equal(cols["label"][:, 0], labels[3:9])
+
+    rows = list(reader.read_records(_Task))
+    np.testing.assert_array_equal(rows[0][0], images[3])
+    assert rows[0][1] == labels[3]
+
+
+def test_random_crop_flip_is_window_of_source():
+    images, _ = _synthetic_images(16, 24, seed=1)
+    rng = np.random.default_rng(3)
+    out = image_plane.random_crop_flip(images, 18, rng)
+    assert out.shape == (16, 18, 18, 3) and out.dtype == np.uint8
+    # Every output is some 18x18 window of its source (possibly flipped).
+    for i in range(4):
+        found = False
+        for flipped in (out[i], out[i, :, ::-1]):
+            for dy in range(24 - 18 + 1):
+                for dx in range(24 - 18 + 1):
+                    if np.array_equal(
+                        flipped, images[i, dy:dy + 18, dx:dx + 18]
+                    ):
+                        found = True
+        assert found, f"sample {i} is not a crop/flip of its source"
+    # Same-size crop without flip is the identity.
+    same = image_plane.random_crop_flip(
+        images, 24, np.random.default_rng(0), flip=False
+    )
+    np.testing.assert_array_equal(same, images)
+    with pytest.raises(ValueError):
+        image_plane.random_crop_flip(images, 25, rng)
+
+
+def test_center_crop():
+    images, _ = _synthetic_images(3, 21)
+    out = image_plane.center_crop(images, 15)
+    np.testing.assert_array_equal(out, images[:, 3:18, 3:18])
+
+
+def test_columnar_dataset_fn_train_and_eval(monkeypatch):
+    images, labels = _synthetic_images(10, 16, seed=2)
+    columns = {
+        "image": images.reshape((10, -1)),
+        "label": labels.reshape((10, 1)),
+    }
+    monkeypatch.setattr(zoo, "IMAGE_SIZE", 12)
+    feats, labs = zoo.columnar_dataset_fn(dict(columns), "training", None)
+    assert feats.shape == (10, 12, 12, 3) and feats.dtype == np.uint8
+    assert labs.shape == (10,)
+    # Eval path: deterministic center crop, labels unpermuted.
+    feats_e, labs_e = zoo.columnar_dataset_fn(
+        dict(columns), "evaluation", None
+    )
+    np.testing.assert_array_equal(feats_e, images[:, 2:14, 2:14])
+    np.testing.assert_array_equal(labs_e, labels)
+    # Records smaller than the train size pass through at their own size.
+    monkeypatch.setattr(zoo, "IMAGE_SIZE", 224)
+    feats_s, _ = zoo.columnar_dataset_fn(dict(columns), "evaluation", None)
+    assert feats_s.shape == (10, 16, 16, 3)
+
+
+def test_resnet_trains_from_etrf_through_task_pipeline(tmp_path):
+    """The VERDICT 'Done' gate: ResNet fed from an ETRF image file
+    through the real task pipeline (master task queue -> reader ->
+    columnar materialization -> trainer), in-process Local mode."""
+    from elasticdl_tpu.client import api
+    from elasticdl_tpu.common.args import parse_master_args
+
+    path = str(tmp_path / "imagenet.etrf")
+    images, labels = _synthetic_images(64, 24, classes=4, seed=4)
+    # Make the task learnable: class-dependent bright patch.
+    for cls in range(4):
+        images[labels == cls, 2 + cls * 5 : 6 + cls * 5, 2:6, cls % 3] = 250
+    image_plane.write_image_etrf(path, images, labels)
+
+    args = parse_master_args([
+        "--model_zoo", "model_zoo",
+        "--model_def", "resnet50.resnet50_subclass",
+        "--model_params", "num_classes=4",
+        "--distribution_strategy", "Local",
+        "--training_data", path,
+        "--minibatch_size", "8",
+        "--num_epochs", "2",
+    ])
+    assert api._run_local(args, mode="training") == 0

@@ -50,7 +50,9 @@ def test_trains_and_bn_state_updates():
     model = zoo.custom_model(num_classes=4, use_bf16=True)
     trainer = Trainer(model, zoo.loss, optax.sgd(0.05, momentum=0.9), seed=0)
     rng = np.random.RandomState(0)
-    images = rng.rand(8, 32, 32, 3).astype(np.float32)
+    # Raw uint8 pixels: the input contract since round 5 — the model
+    # normalizes (0-255 scale) on device.
+    images = rng.randint(0, 256, size=(8, 32, 32, 3)).astype(np.uint8)
     labels = rng.randint(0, 4, size=8).astype(np.int32)
     trainer.ensure_initialized(images)
     bn_before = {
