@@ -36,6 +36,8 @@ regression test.
 Usage:
     python scripts/convergence_ab.py --all --out /tmp/conv_ab.jsonl
     python scripts/convergence_ab.py --w 16 --bias global   # one config
+    # round-5 seed replication (3 seeds x the 3 shipped configs):
+    python scripts/convergence_ab.py --all --sweep-seeds 0,1,2 --out f.jsonl
 """
 
 from __future__ import annotations
@@ -71,13 +73,19 @@ def run_config(args) -> dict:
     from model_zoo.deepfm import deepfm_functional_api as zoo
 
     n_train = args.batch * args.steps_per_epoch
+    # Seed replication (round-5 VERDICT weak #3): --seed offsets the DRAW
+    # seeds and the trainer INIT seed but keeps weights_seed=0 — every
+    # seed trains on the same ground-truth task, so within a seed the
+    # configs share identical data/init (the controlled pairwise A/B) and
+    # across seeds the peak-AUC spread is the error bar.  seed=0
+    # reproduces the round-4 runs bit-for-bit.
     dense, cats, labels = datasets.synthetic_ctr_columns(
         n_train,
         num_dense=zoo.NUM_DENSE,
         num_categorical=zoo.NUM_CAT,
         vocab_size=args.vocab,
         weights_seed=0,
-        draw_seed=1,
+        draw_seed=1 + 1000 * args.seed,
         zipf_s=args.zipf,
     )
     e_dense, e_cats, e_labels = datasets.synthetic_ctr_columns(
@@ -86,7 +94,7 @@ def run_config(args) -> dict:
         num_categorical=zoo.NUM_CAT,
         vocab_size=args.vocab,
         weights_seed=0,
-        draw_seed=2,
+        draw_seed=2 + 1000 * args.seed,
         zipf_s=args.zipf,
     )
 
@@ -103,7 +111,7 @@ def run_config(args) -> dict:
             args.emb_lr, bias_correction=args.bias
         ),
         sparse_apply_every=args.w,
-        seed=0,
+        seed=args.seed,
     )
     mask = np.ones((args.batch,), np.float32)
 
@@ -155,10 +163,13 @@ def run_config(args) -> dict:
     result = {
         "w": args.w,
         "bias": args.bias,
+        "seed": args.seed,
         "emb_lr": args.emb_lr,
         "vocab": args.vocab,
         "zipf": args.zipf,
         "epochs": epochs,
+        "peak_auc": max(e["auc"] for e in epochs),
+        "min_logloss": min(e["logloss"] for e in epochs),
         "final_auc": epochs[-1]["auc"],
         "final_logloss": epochs[-1]["logloss"],
         "train_samples_per_sec": round(
@@ -178,12 +189,28 @@ CONFIGS = [
 ]
 
 
+# The seed-replication grid (round-5 VERDICT weak #3): the strict golden
+# anchor and the two windowed configs the headline metrics actually use,
+# each replicated across 3 draw/init seeds.  The full W sweep stays
+# single-seed in CONFIGS (the ordering question only matters for the
+# shipped configs).
+SEED_CONFIGS = [(1, "per_row"), (16, "global"), (32, "global")]
+
+
 def run_all(args) -> None:
+    if args.sweep_seeds:
+        grid = [
+            (w, bias, seed)
+            for seed in [int(s) for s in args.sweep_seeds.split(",")]
+            for (w, bias) in SEED_CONFIGS
+        ]
+    else:
+        grid = [(w, bias, args.seed) for (w, bias) in CONFIGS]
     rows = []
-    for w, bias in CONFIGS:
+    for w, bias, seed in grid:
         cmd = [
             sys.executable, __file__,
-            "--w", str(w), "--bias", bias,
+            "--w", str(w), "--bias", bias, "--seed", str(seed),
             "--vocab", str(args.vocab), "--batch", str(args.batch),
             "--steps-per-epoch", str(args.steps_per_epoch),
             "--epochs", str(args.epochs),
@@ -191,7 +218,7 @@ def run_all(args) -> None:
             "--window", str(args.window), "--zipf", str(args.zipf),
             "--emb-lr", str(args.emb_lr),
         ]
-        print(f"=== W={w} bias={bias} ===", flush=True)
+        print(f"=== W={w} bias={bias} seed={seed} ===", flush=True)
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             # A diverging config (NaN losses tripping the child's isfinite
@@ -199,7 +226,7 @@ def run_all(args) -> None:
             # configs and the summary table must still come out.
             print(proc.stdout[-4000:], file=sys.stderr)
             print(proc.stderr[-4000:], file=sys.stderr)
-            result = {"w": w, "bias": bias, "status": "failed"}
+            result = {"w": w, "bias": bias, "seed": seed, "status": "failed"}
         else:
             result = json.loads(proc.stdout.strip().splitlines()[-1])
         rows.append(result)
@@ -208,23 +235,47 @@ def run_all(args) -> None:
         if args.out:
             with open(args.out, "a") as f:
                 f.write(line + "\n")
-    print("\n| W | bias | final AUC | final logloss | samples/s |")
-    print("|---|------|-----------|---------------|-----------|")
+    print("\n| W | bias | seed | peak AUC | min logloss | samples/s |")
+    print("|---|------|------|----------|-------------|-----------|")
     for r in rows:
         if r.get("status") == "failed":
-            print(f"| {r['w']} | {r['bias']} | FAILED | FAILED | — |")
+            print(f"| {r['w']} | {r['bias']} | {r.get('seed', '?')} "
+                  f"| FAILED | FAILED | — |")
             continue
         print(
-            f"| {r['w']} | {r['bias']} | {r['final_auc']:.5f} "
-            f"| {r['final_logloss']:.5f} "
+            f"| {r['w']} | {r['bias']} | {r['seed']} "
+            f"| {r['peak_auc']:.5f} | {r['min_logloss']:.5f} "
             f"| {r['train_samples_per_sec']:,.0f} |"
         )
+    if args.sweep_seeds:
+        print("\n| W | bias | peak AUC mean ± half-range | n seeds |")
+        print("|---|------|----------------------------|---------|")
+        for w, bias in SEED_CONFIGS:
+            aucs = [
+                r["peak_auc"] for r in rows
+                if r.get("status") != "failed"
+                and (r["w"], r["bias"]) == (w, bias)
+            ]
+            if not aucs:
+                continue
+            mid = (max(aucs) + min(aucs)) / 2
+            half = (max(aucs) - min(aucs)) / 2
+            print(
+                f"| {w} | {bias} | {np.mean(aucs):.5f} ± {half:.5f} "
+                f"(mid {mid:.5f}) | {len(aucs)} |"
+            )
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--all", action="store_true")
+    p.add_argument(
+        "--sweep-seeds", default="",
+        help="comma-separated seed list; with --all, runs SEED_CONFIGS "
+             "x seeds instead of the single-seed CONFIGS sweep",
+    )
     p.add_argument("--w", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
     p.add_argument("--bias", choices=["per_row", "global"], default="global")
     p.add_argument("--vocab", type=int, default=100_000)
     p.add_argument("--batch", type=int, default=8192)
