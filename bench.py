@@ -638,11 +638,16 @@ def bench_transformer(
 # - 819 GB/s: v5e HBM bandwidth (the ResNet roofline analysis).
 # - 25 ns/row: measured count-bound floor of the sparse embedding path
 #   (lookup-gather + grad-scatter per touched row, BASELINE.md).
-# - 1.94M rec/s: measured single-core ETRF parse ceiling (data plane).
+# - 4.52M rec/s: measured single-core ETRF parse ceiling (data plane;
+#   see HOST_PARSE_CEILING_RPS below for the history).
 PEAK_BF16_FLOPS = 197e12
 HBM_BYTES_PER_SEC = 819e9
 SPARSE_FLOOR_NS_PER_ROW = 25.0
-HOST_PARSE_CEILING_RPS = 1.94e6
+# Vectorized ETRF read+parse ceiling for Criteo-shaped records on one
+# host core.  Round 3 measured 1.94M rec/s; the round-5 slicing-by-8
+# CRC-32 (native recordfile.cc) re-measured it at 4.52M rec/s — the
+# byte-at-a-time CRC was the binding cost (BASELINE.md data plane).
+HOST_PARSE_CEILING_RPS = 4.52e6
 # The chip's own measured ResNet-50 train rate (the tracked device
 # metric) — the anchor the image HOST pipeline is judged against.
 RESNET_DEVICE_IMG_PER_SEC = 2_665.0

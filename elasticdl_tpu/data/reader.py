@@ -338,6 +338,96 @@ class RecordIODataReader(AbstractDataReader):
         yield from recordfile.read_range(task.shard_name, task.start, task.end)
 
 
+def is_etrf_dir(path: str) -> bool:
+    """True when `path` is a directory holding .etrf shard files (the
+    reference's RecordIO-directory dataset layout)."""
+    return os.path.isdir(path) and any(
+        name.endswith(".etrf") for name in os.listdir(path)
+    )
+
+
+class FixedWidthEtrfReader(AbstractDataReader):
+    """ETRF shards of fixed-width binary records with the vectorized
+    columnar surface (data/vectorized.py + data/columnar.py).
+
+    `path` is one .etrf file or a DIRECTORY of them — the reference's
+    RecordIO-directory layout (†data/reader/recordio_reader.py): each
+    file is one shard in the master's dynamic-sharding queue, tasks
+    address [start, end) WITHIN their shard.  Subclasses supply the
+    record layout and the per-row assembly for the per-record fallback
+    path; the columnar fast path needs nothing else."""
+
+    #: subclasses whose columnar consumers immediately gather into fresh
+    #: arrays (the image crop) set False to skip the defensive copy.
+    copy_columns = True
+
+    def __init__(self, path: str, **kwargs):
+        super().__init__(**kwargs)
+        self._path = path
+
+    def _files(self):
+        if os.path.isdir(self._path):
+            files = sorted(
+                os.path.join(self._path, name)
+                for name in os.listdir(self._path)
+                if name.endswith(".etrf")
+            )
+            if not files:
+                raise ValueError(f"no .etrf shards under {self._path}")
+            return files
+        return [self._path]
+
+    def shard_names(self):
+        return self._files()
+
+    def create_shards(self):
+        from elasticdl_tpu.data import recordfile
+
+        return {p: recordfile.count_records(p) for p in self._files()}
+
+    def layout(self):
+        """The RecordLayout shared by every shard."""
+        raise NotImplementedError
+
+    def _task_path(self, task) -> str:
+        # Tasks carry their shard (file) name; harnesses that fake a
+        # task over a SINGLE-file reader may omit it.  A directory
+        # reader must never guess — serving shard 0 for every task
+        # would be silently wrong data.
+        path = getattr(task, "shard_name", None)
+        if path:
+            return path
+        files = self._files()
+        if len(files) > 1:
+            raise ValueError(
+                "task has no shard_name but this reader holds "
+                f"{len(files)} shards under {self._path}"
+            )
+        return files[0]
+
+    def read_columns(self, task):
+        from elasticdl_tpu.data import recordfile
+
+        layout = self.layout()
+        for buf, lengths in recordfile.read_range_buffers(
+            self._task_path(task), task.start, task.end
+        ):
+            yield layout.parse_buffer(
+                buf, lengths, copy=self.copy_columns
+            )
+
+    def _row(self, cols, i):
+        """One record of a columnar chunk -> the per-record dataset
+        item (the reference-parity fallback path)."""
+        raise NotImplementedError
+
+    def read_records(self, task):
+        for cols in self.read_columns(task):
+            n = len(next(iter(cols.values())))
+            for i in range(n):
+                yield self._row(cols, i)
+
+
 def _odps_reader(**kwargs):
     from elasticdl_tpu.data.odps_reader import ODPSDataReader
 

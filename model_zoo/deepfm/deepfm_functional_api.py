@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from elasticdl_tpu.data.reader import AbstractDataReader
+from elasticdl_tpu.data.reader import FixedWidthEtrfReader
 from elasticdl_tpu.layers import Embedding
 from elasticdl_tpu.parallel import sparse_optim
 from model_zoo import datasets
@@ -227,42 +227,25 @@ def criteo_record_layout():
     ])
 
 
-class CriteoRecordReader(AbstractDataReader):
-    """Shard-addressable reader over a Criteo-layout ETRF file using the
-    vectorized buffer path: whole chunks parse into columnar numpy in
-    one pass, records yield as cheap row views — no per-record byte
-    objects or struct unpacking.  Subclasses AbstractDataReader, so the
-    collective worker's shard_names()/metadata surface works unchanged."""
+class CriteoRecordReader(FixedWidthEtrfReader):
+    """Shard-addressable reader over Criteo-layout ETRF (one file or a
+    directory of shard files — the reference's RecordIO-dir layout)
+    using the vectorized buffer path: whole chunks parse into columnar
+    numpy in one pass, records yield as cheap row views — no per-record
+    byte objects or struct unpacking."""
 
     def __init__(self, path: str, **kwargs):
-        super().__init__(**kwargs)
-        self._path = path
+        super().__init__(path, **kwargs)
         self._layout = criteo_record_layout()
 
-    def create_shards(self):
-        from elasticdl_tpu.data import recordfile
+    def layout(self):
+        return self._layout
 
-        return {self._path: recordfile.count_records(self._path)}
-
-    def read_records(self, task):
-        for cols in self.read_columns(task):
-            dense, cat, label = cols["dense"], cols["cat"], cols["label"]
-            for i in range(len(label)):
-                yield (
-                    {"dense": dense[i], "cat": cat[i]},
-                    np.int32(label[i, 0]),
-                )
-
-    def read_columns(self, task):
-        """Columnar fast path (data/columnar.py): chunk dicts of
-        [n, k] arrays straight from the ETRF buffer parse — no
-        per-record objects."""
-        from elasticdl_tpu.data import recordfile
-
-        for buf, lengths in recordfile.read_range_buffers(
-            self._path, task.start, task.end
-        ):
-            yield self._layout.parse_buffer(buf, lengths)
+    def _row(self, cols, i):
+        return (
+            {"dense": cols["dense"][i], "cat": cols["cat"][i]},
+            np.int32(cols["label"][i, 0]),
+        )
 
 
 def custom_data_reader(data_path: str, **kwargs):
@@ -276,7 +259,9 @@ def custom_data_reader(data_path: str, **kwargs):
             seed=params.get("seed", 0),
             shard_name="criteo-synth",
         )
+    from elasticdl_tpu.data.reader import is_etrf_dir
+
     path = data_path.removeprefix("recordio:")
-    if path.endswith(".etrf"):
+    if path.endswith(".etrf") or is_etrf_dir(path):
         return CriteoRecordReader(path)
     return None

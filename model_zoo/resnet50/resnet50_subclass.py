@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from elasticdl_tpu.data.reader import FixedWidthEtrfReader
 from model_zoo import datasets
 
 Dtype = Any
@@ -210,20 +211,28 @@ def columnar_dataset_fn(columns, mode, metadata, seed: int = 0):
     return images, labels
 
 
-class ImageRecordReader(datasets.AbstractDataReader):
-    """Shard-addressable reader over an image-ETRF file (fixed-size
-    uint8 records, data/image.py layout) using the vectorized buffer
-    path — the vision twin of deepfm's CriteoRecordReader, so the
-    collective worker's task pipeline (shards, columnar fast path,
-    per-record fallback) works unchanged."""
+class ImageRecordReader(FixedWidthEtrfReader):
+    """Shard-addressable reader over image-ETRF (one file or a
+    directory of shard files; fixed-size uint8 records, data/image.py
+    layout) using the vectorized buffer path — the vision twin of
+    deepfm's CriteoRecordReader, so the collective worker's task
+    pipeline (shards, columnar fast path, per-record fallback) works
+    unchanged.
+
+    copy_columns=False: image columns go straight into the crop's
+    gather (columnar_dataset_fn), so the defensive parse copy would be
+    a wasted full pass over ~150 KB/record."""
+
+    copy_columns = False
 
     def __init__(self, path: str, size: int = 0, **kwargs):
-        super().__init__(**kwargs)
-        self._path = path
+        super().__init__(path, **kwargs)
         # Self-describing: the fixed record width encodes the stored
         # image size (S*S*3 + 4 label bytes), so readers on any host
         # (cluster worker pods included) need no side-channel config.
-        self._size = size or self._infer_size(path)
+        # All shards of a directory must share one stored size — a
+        # mismatched shard fails loudly in parse_buffer's width check.
+        self._size = size or self._infer_size(self._files()[0])
         from elasticdl_tpu.data.image import image_record_layout
 
         self._layout = image_record_layout(self._size)
@@ -241,31 +250,15 @@ class ImageRecordReader(datasets.AbstractDataReader):
             )
         return size
 
-    def create_shards(self):
-        from elasticdl_tpu.data import recordfile
+    def layout(self):
+        return self._layout
 
-        return {self._path: recordfile.count_records(self._path)}
-
-    def read_records(self, task):
+    def _row(self, cols, i):
         s = self._size
-        for cols in self.read_columns(task):
-            images, label = cols["image"], cols["label"]
-            for i in range(len(label)):
-                yield (
-                    images[i].reshape((s, s, 3)),
-                    np.int32(label[i, 0]),
-                )
-
-    def read_columns(self, task):
-        from elasticdl_tpu.data import recordfile
-
-        for buf, lengths in recordfile.read_range_buffers(
-            self._path, task.start, task.end
-        ):
-            # copy=False: image columns go straight into the crop's
-            # gather (columnar_dataset_fn), so the defensive copy would
-            # be a wasted full pass over ~150 KB/record.
-            yield self._layout.parse_buffer(buf, lengths, copy=False)
+        return (
+            cols["image"][i].reshape((s, s, 3)),
+            np.int32(cols["label"][i, 0]),
+        )
 
 
 def custom_data_reader(data_path: str, **kwargs):
@@ -277,7 +270,9 @@ def custom_data_reader(data_path: str, **kwargs):
             image_size=params.get("size", IMAGE_SIZE),
             num_classes=params.get("classes", NUM_CLASSES),
         )
+    from elasticdl_tpu.data.reader import is_etrf_dir
+
     path = data_path.removeprefix("recordio:")
-    if path.endswith(".etrf"):
+    if path.endswith(".etrf") or is_etrf_dir(path):
         return ImageRecordReader(path, **kwargs)
     return None

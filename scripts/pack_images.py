@@ -1,0 +1,129 @@
+"""Pack an image-classification directory tree into ETRF image shards.
+
+The offline half of the round-5 image data plane (data/image.py): JPEG/
+PNG decode + resize happen ONCE here, so the training hot path streams
+fixed-width raw uint8 records at memcpy-grade rates instead of paying
+per-epoch decode (the classic host-bound trap for TPU input pipelines).
+
+Input layout: the standard class-per-subdirectory tree
+(`root/<class_name>/<image file>`, ImageNet-style); class names map to
+integer labels by sorted order, written alongside as labels.json.
+
+Each image is resized so its SHORTER side equals --size, center-cropped
+square, and stored as [size, size, 3] uint8 — the record-cache
+equivalent of the usual train transform, leaving room for the training
+random crop (e.g. store 256, train 224).  Output is one or more .etrf
+shard files (--records-per-shard); a shard directory feeds
+`ImageRecordReader` (model_zoo/resnet50) directly and each file becomes
+one shard in the master's dynamic-sharding queue.
+
+Usage:
+    python scripts/pack_images.py /data/imagenet/train out_dir \
+        --size 256 --records-per-shard 50000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+IMAGE_SUFFIXES = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def list_dataset(root: str):
+    classes = sorted(
+        name for name in os.listdir(root)
+        if os.path.isdir(os.path.join(root, name))
+    )
+    if not classes:
+        raise ValueError(f"no class subdirectories under {root}")
+    items = []
+    for label, cls in enumerate(classes):
+        for name in sorted(os.listdir(os.path.join(root, cls))):
+            if name.lower().endswith(IMAGE_SUFFIXES):
+                items.append((os.path.join(root, cls, name), label))
+    if not items:
+        raise ValueError(f"no image files under {root}")
+    return classes, items
+
+
+def decode_resize(path: str, size: int) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(path) as img:
+        img = img.convert("RGB")
+        w, h = img.size
+        scale = size / min(w, h)
+        img = img.resize(
+            (max(size, round(w * scale)), max(size, round(h * scale))),
+            Image.BILINEAR,
+        )
+        w, h = img.size
+        left, top = (w - size) // 2, (h - size) // 2
+        img = img.crop((left, top, left + size, top + size))
+        return np.asarray(img, np.uint8)
+
+
+def pack(root: str, out_dir: str, size: int, records_per_shard: int,
+         seed: int = 0) -> int:
+    from elasticdl_tpu.data import recordfile
+    from elasticdl_tpu.data.image import image_record_layout
+
+    classes, items = list_dataset(root)
+    # One global shuffle at packing time so every shard is an unbiased
+    # sample — sequential shard tasks then see mixed classes even
+    # before the per-task training permutation.
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(items))
+    layout = image_record_layout(size)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "labels.json"), "w") as f:
+        json.dump(classes, f)
+
+    n_shards = max(1, -(-len(items) // records_per_shard))
+    written = 0
+    for shard in range(n_shards):
+        lo = shard * records_per_shard
+        chunk = order[lo:lo + records_per_shard]
+        path = os.path.join(out_dir, f"images-{shard:05d}.etrf")
+
+        def records():
+            for idx in chunk:
+                file_path, label = items[idx]
+                image = decode_resize(file_path, size)
+                yield layout.pack(
+                    image=image.reshape(-1),
+                    label=np.int32(label),
+                )
+
+        recordfile.write_records(path, records())
+        written += len(chunk)
+        print(f"{path}: {len(chunk)} records", flush=True)
+    print(
+        f"packed {written} images, {len(classes)} classes -> "
+        f"{n_shards} shard(s) in {out_dir}",
+        flush=True,
+    )
+    return written
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("input", help="class-per-subdirectory image tree")
+    p.add_argument("output", help="output directory for .etrf shards")
+    p.add_argument("--size", type=int, default=256)
+    p.add_argument("--records-per-shard", type=int, default=50_000)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    pack(args.input, args.output, args.size, args.records_per_shard,
+         args.seed)
+
+
+if __name__ == "__main__":
+    main()

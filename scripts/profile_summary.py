@@ -121,9 +121,52 @@ def run_deepfm(batch: int, logdir: str, steps: int = 40):
         np.asarray(trainer.train_window(window))
 
 
+def run_transformer(batch: int, logdir: str, steps: int = 8):
+    """The tracked transformer bench config (bench.TRANSFORMER_BENCH) —
+    the round-5 MFU probe: what are the top NON-attention device ops,
+    and does any exceed its roofline cost?  (VERDICT round-4 #8)."""
+    import jax
+
+    import bench
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+    from model_zoo.transformer import transformer_lm as zoo
+
+    cfg = bench.TRANSFORMER_BENCH
+    mesh = build_mesh(MeshConfig())
+    trainer = DataParallelTrainer(
+        zoo.custom_model(
+            vocab=cfg["vocab"], d_model=cfg["d_model"],
+            num_heads=cfg["num_heads"], num_layers=cfg["num_layers"],
+            max_len=cfg["seq_len"],
+        ),
+        zoo.loss,
+        zoo.optimizer(),
+        mesh,
+    )
+    rng = np.random.RandomState(0)
+    batches = [
+        (
+            rng.randint(0, cfg["vocab"], size=(batch, cfg["seq_len"]))
+            .astype(np.int32),
+            rng.randint(0, cfg["vocab"], size=(batch, cfg["seq_len"]))
+            .astype(np.int32),
+            np.ones((batch,), np.float32),
+        )
+        for _ in range(steps)
+    ]
+    window = trainer.stage_window(batches)
+    np.asarray(trainer.train_window(window))
+    np.asarray(trainer.train_window(window))
+    with jax.profiler.trace(logdir):
+        np.asarray(trainer.train_window(window))
+
+
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("workload", choices=["resnet50", "deepfm"])
+    parser.add_argument(
+        "workload", choices=["resnet50", "deepfm", "transformer"]
+    )
     parser.add_argument("--batch", type=int, default=0)
     parser.add_argument("--logdir", default="")
     parser.add_argument("--norm_f32", action="store_true")
@@ -131,6 +174,8 @@ def main():
     logdir = args.logdir or tempfile.mkdtemp(prefix=f"trace_{args.workload}_")
     if args.workload == "resnet50":
         run_resnet(args.batch or 512, logdir, norm_bf16=not args.norm_f32)
+    elif args.workload == "transformer":
+        run_transformer(args.batch or 16, logdir)
     else:
         run_deepfm(args.batch or 8192, logdir)
     print("trace dir:", logdir)
