@@ -360,6 +360,11 @@ class FixedWidthEtrfReader(AbstractDataReader):
     #: subclasses whose columnar consumers immediately gather into fresh
     #: arrays (the image crop) set False to skip the defensive copy.
     copy_columns = True
+    #: per-chunk payload budget for the columnar path; 0 = the codec's
+    #: default (128 MB).  Readers of large records raise it so a whole
+    #: task arrives as ONE chunk — skipping the downstream concatenate
+    #: and halving peak memory (data/recordfile.read_range_buffers).
+    columnar_chunk_bytes = 0
 
     def __init__(self, path: str, **kwargs):
         super().__init__(**kwargs)
@@ -410,7 +415,8 @@ class FixedWidthEtrfReader(AbstractDataReader):
 
         layout = self.layout()
         for buf, lengths in recordfile.read_range_buffers(
-            self._task_path(task), task.start, task.end
+            self._task_path(task), task.start, task.end,
+            max_bytes=self.columnar_chunk_bytes,
         ):
             yield layout.parse_buffer(
                 buf, lengths, copy=self.copy_columns

@@ -159,19 +159,29 @@ def read_all(path: str) -> Iterator[bytes]:
     yield from read_range(path, 0, count_records(path))
 
 
-def read_range_buffers(path: str, start: int, end: int):
+def read_range_buffers(path: str, start: int, end: int,
+                       max_bytes: int = 0):
     """Yield (payload_buffer np.uint8, lengths np.uint32) chunks of
     records [start, end) — the vectorized data-plane path: payloads ride
     one contiguous buffer per chunk with NO per-record Python objects,
     feeding data/vectorized.py's RecordLayout.parse_buffer directly.
     Native codec when built; Python fallback assembles equivalent
-    chunks."""
+    chunks.
+
+    `max_bytes` overrides the default per-chunk payload bound.
+    Consumers that concatenate the chunks anyway (the columnar task
+    path) pass their whole-task budget: one chunk instead of N both
+    skips the concatenate pass and HALVES peak memory (no chunks+copy
+    coexistence) — at image record sizes that pass was ~20% of the
+    host pipeline."""
     import numpy as np
 
     native = _native()
     if native is not None:
         try:
-            yield from native.read_range_buffers(path, start, end)
+            yield from native.read_range_buffers(
+                path, start, end, max_bytes=max_bytes
+            )
         except RecordFileError:
             raise
         except OSError as e:
@@ -180,8 +190,16 @@ def read_range_buffers(path: str, start: int, end: int):
     # Same chunk bounds as the native codec (one source of truth).
     from elasticdl_tpu.native import NativeRecordFile
 
+    # The fallback IGNORES a larger max_bytes: it accumulates per-record
+    # bytes objects before the join, so honoring a 1 GiB budget would
+    # hold the object list AND the joined copy simultaneously (~2x task
+    # bytes + object overhead) — the opposite of the memory win the
+    # budget buys on the native path.  Downstream columnar consumers
+    # already handle multi-chunk results (they concatenate), so a
+    # smaller-than-requested chunking is always correct.
     max_records = NativeRecordFile.CHUNK_RECORDS
-    max_bytes = NativeRecordFile.CHUNK_BYTES
+    max_bytes = min(max_bytes or NativeRecordFile.CHUNK_BYTES,
+                    NativeRecordFile.CHUNK_BYTES)
 
     def emit(records):
         buf = np.frombuffer(b"".join(records), np.uint8)

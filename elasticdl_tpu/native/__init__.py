@@ -273,11 +273,15 @@ class NativeRecordFile:
                 yield bytes(view[offset : offset + int(length)])
                 offset += int(length)
 
-    def read_range_buffers(self, path: str, start: int, end: int):
+    def read_range_buffers(self, path: str, start: int, end: int,
+                           max_bytes: int = 0):
         """Yield (payloads np.uint8 buffer, lengths np.uint32) CHUNKS of
         records [start, end) — payloads back-to-back, no per-record
         Python objects (the vectorized data-plane path; see
-        data/vectorized.py)."""
+        data/vectorized.py).  `max_bytes` overrides the default chunk
+        byte bound (and lifts the record cap — the caller's byte budget
+        is the bound; see data/recordfile.read_range_buffers)."""
+        bytes_cap = max_bytes or self.CHUNK_BYTES
         handle = self._lib.edl_rf_open(path.encode())
         if not handle:
             raise IOError(self._error())
@@ -287,11 +291,14 @@ class NativeRecordFile:
             end = min(end, count)
             pos = start
             while pos < end:
-                n = min(self.CHUNK_RECORDS, end - pos)
+                n = (
+                    end - pos if max_bytes
+                    else min(self.CHUNK_RECORDS, end - pos)
+                )
                 total = int(self._lib.edl_rf_range_size(handle, pos, pos + n))
                 if total < 0:
                     raise IOError(self._error())
-                while n > 1 and total > self.CHUNK_BYTES:
+                while n > 1 and total > bytes_cap:
                     n //= 2  # range_size is O(1) over the index
                     total = int(
                         self._lib.edl_rf_range_size(handle, pos, pos + n)

@@ -221,9 +221,12 @@ class ImageRecordReader(FixedWidthEtrfReader):
 
     copy_columns=False: image columns go straight into the crop's
     gather (columnar_dataset_fn), so the defensive parse copy would be
-    a wasted full pass over ~150 KB/record."""
+    a wasted full pass over ~150 KB/record.  A 1 GiB chunk budget
+    (matching the worker's staged-bytes cap scale) delivers a whole
+    task as one buffer — no concatenate pass, half the peak memory."""
 
     copy_columns = False
+    columnar_chunk_bytes = 1 << 30
 
     def __init__(self, path: str, size: int = 0, **kwargs):
         super().__init__(path, **kwargs)

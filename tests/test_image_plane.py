@@ -119,8 +119,17 @@ def test_resnet_trains_from_etrf_through_task_pipeline(tmp_path):
         "--training_data", path,
         "--minibatch_size", "8",
         "--num_epochs", "2",
+        "--output", str(tmp_path / "model"),
     ])
     assert api._run_local(args, mode="training") == 0
+
+    # The servable artifact predicts from RAW uint8 (the round-5 input
+    # contract: normalization lives in the model, on device).
+    from elasticdl_tpu.serving import load_for_serving
+
+    served = load_for_serving(str(tmp_path / "model"))
+    out = np.asarray(served.predict(images[:4]))
+    assert out.shape == (4, 4) and np.isfinite(out).all()
 
 
 def test_sharded_image_dir_reader(tmp_path):

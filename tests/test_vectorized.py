@@ -77,6 +77,38 @@ def test_read_range_buffers_python_fallback(tmp_path, monkeypatch):
     )
 
 
+def test_read_range_buffers_max_bytes_budget(tmp_path, monkeypatch):
+    """`max_bytes` (round 5): the native codec honors a whole-task
+    budget (one chunk) and splits under a small one; the Python
+    fallback deliberately caps at its default streaming bound (memory —
+    see recordfile.read_range_buffers) — both yield identical DATA at
+    any budget."""
+    recs = _records(100, seed=5)
+    path = str(tmp_path / "g.etrf")
+    recordfile.write_records(path, recs)
+    rec_bytes = len(recs[0])
+
+    whole = list(recordfile.read_range_buffers(path, 0, 100,
+                                               max_bytes=1 << 30))
+    assert len(whole) == 1  # native: whole task, one chunk
+    small = list(recordfile.read_range_buffers(path, 0, 100,
+                                               max_bytes=10 * rec_bytes))
+    assert len(small) > 1  # budget smaller than the task splits
+
+    def payload(chunks):
+        return b"".join(bytes(b) for b, _ in chunks)
+
+    assert payload(whole) == payload(small)
+    monkeypatch.setattr(recordfile, "_native", lambda: None)
+    for budget in (1 << 30, 10 * rec_bytes, 0):
+        fallback = list(recordfile.read_range_buffers(path, 0, 100,
+                                                      max_bytes=budget))
+        assert payload(fallback) == payload(whole)
+        assert np.concatenate([l for _, l in fallback]).tolist() == (
+            np.concatenate([l for _, l in whole]).tolist()
+        )
+
+
 def test_parse_buffer_length_validation():
     recs = _records(4)
     buf = np.frombuffer(b"".join(recs), np.uint8)
