@@ -372,3 +372,53 @@ class TestAutoWindowSizing:
     def test_explicit_window_grows_to_apply_multiple(self):
         w = self._worker(train_window_steps=6, apply_every=4)
         assert w._window_steps == 8
+
+
+def test_auto_apply_resync_grows_explicit_window():
+    """--sparse_apply_every=auto resolves inside the trainer at init;
+    the worker re-syncs its dispatch-window sizing right after
+    (collective_worker._sync_apply_every) — an explicit window then
+    grows to a chunk multiple exactly as a numeric flag would have
+    grown it at construction."""
+    from elasticdl_tpu.parallel.elastic import WorldInfo
+    from elasticdl_tpu.worker.collective_worker import CollectiveWorker
+
+    class FakeReader:
+        metadata = None
+
+        def create_shards(self):
+            return {"s": 4}
+
+        def shard_names(self):
+            return ["s"]
+
+    class FakeTrainer:
+        mesh = build_mesh(MeshConfig())
+        _sparse_apply_every = None  # auto, unresolved until init
+
+        def local_block(self, mb):
+            return mb
+
+    class FakeSpec:
+        dataset_fn = None
+
+    trainer = FakeTrainer()
+    worker = CollectiveWorker(
+        master_client=None,
+        model_spec=FakeSpec(),
+        data_reader=FakeReader(),
+        minibatch_size=4,
+        world=WorldInfo(rank=0, world_size=1, rendezvous_id=1,
+                        coordinator_addr="x"),
+        trainer=trainer,
+        train_window_steps=10,
+    )
+    # Unresolved auto reads as strict: no growth at construction.
+    assert worker._apply_every == 1
+    assert worker._window_steps == 10
+
+    trainer._sparse_apply_every = 32  # what ensure_initialized resolves
+    assert worker._sync_apply_every() is True
+    assert worker._apply_every == 32
+    assert worker._window_steps == 32  # grown to the chunk multiple
+    assert worker._sync_apply_every() is False  # idempotent
