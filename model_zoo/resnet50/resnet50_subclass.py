@@ -144,12 +144,41 @@ def optimizer(lr: float = 0.1):
     return optax.sgd(lr, momentum=0.9, nesterov=True)
 
 
+# Per-call seed counter for the per-record path's augmentation: every
+# dataset_fn call (one per task materialization) draws a fresh seed, so
+# crops/flips vary across tasks and epochs — the per-record twin of the
+# columnar path's task/epoch-derived seed.  Deterministic across ranks
+# because lockstep workers materialize the same broadcast tasks in the
+# same order (and reset together on world re-formation).
+_DATASET_FN_CALLS = [0]
+
+
 def dataset_fn(dataset, mode, metadata):
     # The host stays in uint8: normalization happens on device (the
-    # model's `normalize` head), so parse is shape/type assembly only.
+    # model's `normalize` head).  SQUARE records stored larger than the
+    # train size get the SAME crop semantics as the columnar fast path
+    # (random crop+flip in training, center crop in eval) — the two
+    # paths must feed identical shapes or a job would silently change
+    # geometry with its reader's capabilities.  Non-square images (a
+    # custom reader's) pass through untouched, as before round 5.
+    from elasticdl_tpu.data import image as image_plane
+
+    _DATASET_FN_CALLS[0] += 1
+    rng = np.random.default_rng(_DATASET_FN_CALLS[0])
+
     def parse(record):
         image, label = record
-        return np.asarray(image, np.uint8), np.int32(label)
+        image = np.ascontiguousarray(image, np.uint8)
+        square = image.ndim == 3 and image.shape[0] == image.shape[1]
+        if square:
+            crop = min(IMAGE_SIZE, image.shape[0])
+            if mode == "training":
+                image = image_plane.random_crop_flip(
+                    image[None], crop, rng
+                )[0]
+            elif image.shape[0] > crop:
+                image = image_plane.center_crop(image[None], crop)[0]
+        return image, np.int32(label)
 
     dataset = dataset.map(parse)
     if mode == "training":

@@ -132,6 +132,75 @@ def test_resnet_trains_from_etrf_through_task_pipeline(tmp_path):
     assert out.shape == (4, 4) and np.isfinite(out).all()
 
 
+def test_per_record_dataset_fn_matches_columnar_geometry(monkeypatch):
+    """The per-record path (Local mode / non-columnar readers) must feed
+    the SAME image geometry as the columnar fast path: train = random
+    crop+flip to IMAGE_SIZE, eval = center crop; smaller records pass
+    through at their own size."""
+    from elasticdl_tpu.data.dataset import Dataset
+
+    monkeypatch.setattr(zoo, "IMAGE_SIZE", 12)
+    images, labels = _synthetic_images(6, 16, seed=11)
+    records = list(zip(images, labels))
+
+    train = zoo.dataset_fn(Dataset.from_iterable(records), "training", None)
+    train_rows = list(train)
+    assert all(img.shape == (12, 12, 3) for img, _ in train_rows)
+
+    ev = zoo.dataset_fn(Dataset.from_iterable(records), "evaluation", None)
+    ev_rows = list(ev)
+    np.testing.assert_array_equal(ev_rows[0][0], images[0][2:14, 2:14])
+
+    small = zoo.dataset_fn(
+        Dataset.from_iterable([(images[0][:8, :8], labels[0])]),
+        "evaluation", None,
+    )
+    assert next(iter(small))[0].shape == (8, 8, 3)
+
+
+def test_image_evaluate_only_from_etrf(tmp_path, monkeypatch):
+    """Evaluation mode through the real pipeline.  The metric fn is
+    spied on: it must see EVERY record exactly once (the full-set
+    metric contract) with finite outputs of the model's class count."""
+    from elasticdl_tpu.client import api
+    from elasticdl_tpu.common.args import parse_master_args
+
+    path = str(tmp_path / "val.etrf")
+    images, labels = _synthetic_images(48, 24, classes=4, seed=9)
+    image_plane.write_image_etrf(path, images, labels)
+
+    seen = []
+
+    def spying_metrics():
+        def accuracy(outputs, labels_):
+            outputs = np.asarray(outputs)
+            assert outputs.shape[1] == 4 and np.isfinite(outputs).all()
+            seen.append((outputs.shape[0], np.sort(np.asarray(labels_))))
+            return float(
+                np.mean(np.argmax(outputs, axis=1) == labels_)
+            )
+
+        return {"accuracy": accuracy}
+
+    monkeypatch.setattr(zoo, "eval_metrics_fn", spying_metrics)
+    args = parse_master_args([
+        "--model_zoo", "model_zoo",
+        "--model_def", "resnet50.resnet50_subclass",
+        "--model_params", "num_classes=4",
+        "--distribution_strategy", "Local",
+        "--validation_data", path,
+        "--records_per_task", "24",
+        "--minibatch_size", "8",
+    ])
+    assert api._run_local(args, mode="evaluation") == 0
+    # One finalized round over the WHOLE validation set, every label
+    # present (order-independent: eval tasks may interleave).
+    assert len(seen) == 1
+    n, metric_labels = seen[0]
+    assert n == 48
+    np.testing.assert_array_equal(metric_labels, np.sort(labels))
+
+
 def test_sharded_image_dir_reader(tmp_path):
     """A DIRECTORY of .etrf files is the reference's RecordIO-dir
     dataset layout: each file is one shard; tasks address [start, end)
