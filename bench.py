@@ -118,11 +118,12 @@ SELF_BASELINE = {
     # therefore tracks drift against the round-2 recording in BASELINE.md.
     "resnet50_images_per_sec_per_chip": 1_524.0,
     # The vision data plane, file -> staged uint8 batches, one host core
-    # (first measured round 5: 2,116 img/s on an idle CI host after the
-    # slice-by-8 CRC, no-copy parse, fused permute+crop+in-loop-flip,
-    # and whole-task single-chunk reads — BASELINE.md image data plane
-    # section; halves under heavy concurrent load on the 1-core box).
-    "resnet50_e2e_host_pipeline_images_per_sec": 2_116.0,
+    # (first measured round 5: 2,464 img/s on an idle CI host after the
+    # size-dispatched CRC (zlib >= 512 B payloads), no-copy parse, fused
+    # permute+crop+in-loop-flip, and whole-task single-chunk reads —
+    # BASELINE.md image data plane section; halves under heavy
+    # concurrent load on the 1-core box).
+    "resnet50_e2e_host_pipeline_images_per_sec": 2_464.0,
     # Coupled file->device rate. PROVISIONAL: the tunnel was down for
     # the whole round-5 build window, so no chip measurement exists yet;
     # vs_baseline is meaningful from the first driver bench run.
