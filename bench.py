@@ -834,7 +834,52 @@ def _emit(metric: str, value: float, unit: str, spread: float,
     print(json.dumps(row), flush=True)
 
 
+def _require_live_backend(timeout_s: int = 180, probe_fn=None):
+    """Fail FAST if the accelerator backend is unreachable.  The axon
+    tunnel can die mid-session (observed round 5: ~5 h outage), and a
+    dead tunnel makes the first jax.devices() block FOREVER inside the
+    PJRT client init — turning the driver's bench run into an unbounded
+    hang instead of a recorded failure.  A Python signal handler can't
+    fire during a hung C call (the interpreter never regains control),
+    so the escape is faulthandler's C-level watchdog thread: it dumps
+    the stack and hard-exits without needing the GIL.
+
+    `probe_fn` overrides the real device probe (host-side tests must
+    not initialize the live backend)."""
+    import faulthandler
+    import sys
+
+    print(
+        json.dumps({
+            "metric": "bench_backend_probe",
+            "value": 0,
+            "unit": "none",
+            "note": (
+                f"probing the accelerator backend (timeout {timeout_s}s)"
+                " — if this run's output ENDS here with a dumped stack,"
+                " the backend/tunnel was unreachable and no metrics were"
+                " measured"
+            ),
+        }),
+        flush=True,
+    )
+    sys.stderr.flush()
+    faulthandler.dump_traceback_later(timeout_s, exit=True)
+    try:
+        n = (probe_fn or _device_count)()
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+    print(f"# backend live: {n} device(s)", flush=True)
+
+
+def _device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
 def main():
+    _require_live_backend()
     tokens_per_sec, t_spread = bench_transformer()
     _emit(
         "transformer_lm_tokens_per_sec_per_chip",

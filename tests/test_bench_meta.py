@@ -122,6 +122,20 @@ def test_ring_roofline_reads_ring_bench_config():
     assert doubled["mfu"] == pytest.approx(2 * base["mfu"], rel=0.02)
 
 
+def test_backend_probe_prints_contract(capfd):
+    """The fail-fast backend probe (bench._require_live_backend) must
+    emit its explanatory line BEFORE touching the backend — that line
+    is what makes a tunnel-outage hard-exit diagnosable from the
+    driver's recorded output tail.  The probe itself is injected: a
+    host-side meta test must never initialize the live backend (a dead
+    tunnel would hard-exit the whole pytest process).  capfd, not
+    capsys: faulthandler's watchdog needs a real stderr descriptor."""
+    bench._require_live_backend(timeout_s=120, probe_fn=lambda: 1)
+    out = capfd.readouterr().out
+    assert "bench_backend_probe" in out.splitlines()[0]
+    assert "backend live: 1" in out
+
+
 def test_ring_bench_harness_import():
     """bench_ring_engine loads scripts/exp_ring_perf.py by file path; pin
     the coupling (module loads, exposes run_variant, parses the exact
