@@ -3,7 +3,7 @@
 # first use (pb2 is checked in; the native .so builds lazily); these
 # targets are the explicit developer entry points.
 
-.PHONY: all proto native test e2e bench wheel clean
+.PHONY: all proto native test test-fast test-chaos e2e bench wheel clean
 
 all: proto native test
 
@@ -17,6 +17,18 @@ native:
 
 test:
 	python -m pytest tests/ -q
+
+# Tier-1 fast gate: the correctness surface without the compile-heavy
+# `slow`-marked tests (pyproject registers the markers) — what CI and a
+# review session can finish on the 1-core box.
+test-fast:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# Transient-failure resilience gate: deterministic fault injection
+# (common/faults.py) + the master-SIGKILL / torn-checkpoint chaos e2e.
+test-chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
+	       tests/test_faults.py -q
 
 # The real multi-process end-to-end slices only (elasticity, PS, k8s).
 e2e:

@@ -41,7 +41,12 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+from elasticdl_tpu.checkpoint.saver import (
+    CheckpointSaver,
+    _apply_write_fault,
+    verify_integrity,
+    write_integrity_manifest,
+)
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("checkpoint.sharded")
@@ -77,8 +82,33 @@ class ShardedCheckpointSaver(CheckpointSaver):
         return os.path.exists(os.path.join(step_dir, _MANIFEST))
 
     def latest_step(self) -> Optional[int]:
-        steps = self.steps()
-        return steps[-1] if steps else None
+        """Newest step that passes its CRC32 integrity inventory.  A torn
+        snapshot (crashed writer, truncated shard file) is quarantined and
+        the previous step wins — restores never touch corrupt state.
+        Transient I/O errors skip the step without quarantining it.
+
+        Only rank 0 pays the full CRC pass; other ranks check
+        existence+size (metadata-only), so re-formation cost does not
+        scale with process count.  In the rare case rank 0 quarantines a
+        bit-rotted snapshot that size-checks clean elsewhere, the ranks
+        pick different steps, the restore-consistency broadcast
+        (collective_worker._verify_restore_consistency) aborts the world,
+        and the re-formed world agrees on the already-quarantined view."""
+        check_crc = jax.process_index() == 0
+        for step in reversed(self.steps()):
+            step_dir = self._step_dir(step)
+            try:
+                reason = verify_integrity(step_dir, check_crc=check_crc)
+            except OSError:
+                logger.exception(
+                    "Could not verify checkpoint %s (transient I/O "
+                    "error?); skipping it this restore", step_dir,
+                )
+                continue
+            if reason is None:
+                return step
+            self._quarantine(step_dir, reason)
+        return None
 
     # -- save (collective) ----------------------------------------------
 
@@ -116,10 +146,15 @@ class ShardedCheckpointSaver(CheckpointSaver):
             f"shards_p{i}of{n_processes}.npz" for i in range(n_processes)
         ]
         np.savez(os.path.join(tmp_dir, shard_files[process]), **entries)
+        # Keep the shared tmp dir's mtime fresh while the save is live so
+        # a restarting peer's stale-tmp sweep (saver.sweep_stale_tmp)
+        # never mistakes an in-flight save for crashed-save garbage.
+        os.utime(tmp_dir)
 
         if process == 0:
             with open(os.path.join(tmp_dir, _DENSE), "wb") as f:
                 pickle.dump(jax.device_get(dense_state), f)
+            os.utime(tmp_dir)
 
         if n_processes > 1:
             from jax.experimental import multihost_utils
@@ -148,6 +183,15 @@ class ShardedCheckpointSaver(CheckpointSaver):
             }
             with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
                 json.dump(manifest, f)
+            # Integrity inventory: every file a restore may read —
+            # INCLUDING manifest.json itself (a torn metadata manifest
+            # would otherwise pass verification and crash restore) — is
+            # checksummed post-barrier (all writers are done), before the
+            # commit rename publishes anything.
+            write_integrity_manifest(
+                tmp_dir, shard_files + [_DENSE, _MANIFEST]
+            )
+            _apply_write_fault(os.path.join(tmp_dir, _DENSE))
             try:
                 os.rename(tmp_dir, final_dir)
             except OSError:

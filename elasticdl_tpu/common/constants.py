@@ -37,6 +37,32 @@ class GRPC:
     MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
     KEEPALIVE_TIME_MS = 30000
     KEEPALIVE_TIMEOUT_MS = 10000
+    # Cap the channel's TCP reconnect backoff: gRPC's default grows the
+    # gap between connection attempts toward 120 s, so a worker whose
+    # channel went TRANSIENT_FAILURE during a brief master restart could
+    # fail RPCs for minutes after the master is back (the retry plane
+    # retries fast, but no attempt can succeed until the channel
+    # reconnects).  2 s bounds outage detection; gRPC's built-in jitter
+    # decorrelates the fleet's reconnect storm.
+    INITIAL_RECONNECT_BACKOFF_MS = 200
+    MIN_RECONNECT_BACKOFF_MS = 200
+    MAX_RECONNECT_BACKOFF_MS = 2000
+
+
+class RPC:
+    # Transient-failure plane (common/grpc_utils.py): every client RPC
+    # carries an explicit deadline; idempotent RPCs retry
+    # UNAVAILABLE/DEADLINE_EXCEEDED with capped exponential backoff.  The
+    # budget is sized to ride through a full master restart (process
+    # spawn + imports + progress-snapshot resume, seconds to ~a minute)
+    # without approaching the pod manager's restart-the-world escalation.
+    DEADLINE_S = 30.0
+    EVAL_REPORT_DEADLINE_S = 120.0  # chunked eval tensors can be large
+    MAX_ATTEMPTS = 24
+    BASE_BACKOFF_S = 0.1
+    MAX_BACKOFF_S = 2.0
+    JITTER = 0.25
+    TOTAL_BUDGET_S = 120.0
 
 
 class WorkerEnv:

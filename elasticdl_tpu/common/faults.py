@@ -1,0 +1,167 @@
+"""Deterministic fault injection for resilience testing.
+
+The elasticity claims of this framework (workers ride through a master
+restart; restores never load a torn checkpoint) are only claims until a
+test can *make* the fault happen on demand.  Chip-side chaos testing is
+unreliable (VERDICT.md records multi-round TPU-tunnel outages), so the
+injection points here are designed to prove the recovery paths on CPU,
+deterministically:
+
+- **call-count triggered** — a fault fires on the Nth..(N+count-1)th call
+  of its site, never on wall clock and never on randomness, so a failing
+  chaos run replays exactly;
+- **off by default and zero-cost when disabled** — `fire()` is a single
+  module-attribute `None` check until `install()`/`ELASTICDL_FAULTS`
+  arms the registry, so production hot paths pay nothing.
+
+Injection sites wired into the framework:
+
+    rpc.<method>   every RPC attempt in grpc_utils.call_with_retry
+                   (kinds: error[=STATUS_CODE], latency[=seconds])
+    ckpt.write     every CheckpointSaver state-file write
+                   (kind: truncate[=keep_bytes] — a torn write)
+    worker.task    every task a worker starts processing
+    worker.step    every train batch in the simple worker
+                   (kind: crash[=exit_code] — SIGKILL-equivalent)
+
+Spec grammar (comma/semicolon separated, via `ELASTICDL_FAULTS` or
+`install()`):
+
+    site:kind[=arg][@after][xcount]
+
+    rpc.get_task:error=UNAVAILABLE@1x3   calls 1-3 raise UNAVAILABLE
+    rpc.get_task:latency=0.25@2          2nd call delayed 0.25 s
+    ckpt.write:truncate@2                2nd checkpoint write torn
+    worker.task:crash@3                  process exits on 3rd task
+
+`after` is 1-based (default 1); `count` is how many consecutive calls
+trigger (default 1, `x*` = every call from `after` on).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+ENV_VAR = "ELASTICDL_FAULTS"
+
+KINDS = ("error", "latency", "truncate", "crash")
+
+
+@dataclass
+class FaultSpec:
+    site: str
+    kind: str
+    arg: str = ""
+    after: int = 1  # first triggering call, 1-based
+    count: int = 1  # number of consecutive triggering calls; -1 = forever
+
+    def triggers_at(self, call_number: int) -> bool:
+        if call_number < self.after:
+            return False
+        return self.count < 0 or call_number < self.after + self.count
+
+
+@dataclass
+class _Registry:
+    specs: List[FaultSpec] = field(default_factory=list)
+    counters: Dict[str, int] = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+# None = disabled; fire() bails on one attribute load, so armed-off cost
+# is zero on hot paths (per-RPC-attempt, per-train-batch).
+_registry: Optional[_Registry] = None
+
+
+def parse_specs(text: str) -> List[FaultSpec]:
+    specs = []
+    for token in text.replace(";", ",").split(","):
+        token = token.strip()
+        if not token:
+            continue
+        try:
+            site, rest = token.split(":", 1)
+            count = 1
+            if "x" in rest.rsplit("@", 1)[-1]:
+                rest, count_text = rest.rsplit("x", 1)
+                count = -1 if count_text == "*" else int(count_text)
+            after = 1
+            if "@" in rest:
+                rest, after_text = rest.rsplit("@", 1)
+                after = int(after_text)
+            kind, _, arg = rest.partition("=")
+        except ValueError as exc:
+            raise ValueError(f"Unparseable fault spec {token!r}") from exc
+        if kind not in KINDS:
+            raise ValueError(
+                f"Unknown fault kind {kind!r} in {token!r} (know {KINDS})"
+            )
+        if after < 1 or (count < 1 and count != -1):
+            raise ValueError(f"Bad @after/xcount in fault spec {token!r}")
+        specs.append(
+            FaultSpec(site=site, kind=kind, arg=arg, after=after, count=count)
+        )
+    return specs
+
+
+def install(specs) -> None:
+    """Arm the registry with FaultSpecs (or a spec string)."""
+    global _registry
+    if isinstance(specs, str):
+        specs = parse_specs(specs)
+    _registry = _Registry(specs=list(specs))
+
+
+def install_from_env(environ=os.environ) -> bool:
+    """Arm from ELASTICDL_FAULTS if set; True when faults were armed.
+    Called at worker/master process start so subprocess chaos tests can
+    inject through the environment."""
+    text = environ.get(ENV_VAR, "")
+    if not text:
+        return False
+    install(text)
+    return bool(_registry.specs)
+
+
+def clear() -> None:
+    global _registry
+    _registry = None
+
+
+def enabled() -> bool:
+    return _registry is not None
+
+
+def call_count(site: str) -> int:
+    if _registry is None:
+        return 0
+    with _registry.lock:
+        return _registry.counters.get(site, 0)
+
+
+def fire(site: str) -> Optional[FaultSpec]:
+    """Count one call of `site`; return the FaultSpec to apply, if any.
+
+    The caller applies the fault (raise / sleep / truncate / exit) — this
+    module never touches the network or filesystem itself, so sites stay
+    import-light and the mapping fault->behavior lives next to the code
+    it perturbs.
+    """
+    registry = _registry
+    if registry is None:
+        return None
+    with registry.lock:
+        registry.counters[site] = n = registry.counters.get(site, 0) + 1
+        for spec in registry.specs:
+            if spec.site == site and spec.triggers_at(n):
+                return spec
+    return None
+
+
+def crash_now(spec: FaultSpec) -> None:
+    """Apply a `crash` fault: immediate process death (no atexit, no
+    flush) — indistinguishable from SIGKILL to the supervisor."""
+    os._exit(int(spec.arg or 13))

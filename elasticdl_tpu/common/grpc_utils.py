@@ -1,20 +1,39 @@
 """gRPC channel/server helpers with framework-wide options.
 
 Parity: elasticdl/python/common/grpc_utils.py in the reference (message size
-limits + keepalive so large checkpoint/eval tensors fit).
+limits + keepalive so large checkpoint/eval tensors fit), extended with the
+transient-failure plane: every RPC carries an explicit deadline, and
+idempotent RPCs retry UNAVAILABLE/DEADLINE_EXCEEDED with exponential
+backoff so a brief master restart or network blip is absorbed at the RPC
+layer instead of crashing the worker and escalating into a (much more
+expensive) restart-the-world re-formation.
 """
 
+from __future__ import annotations
+
+import random
+import threading
+import time
 from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
 
 import grpc
 
-from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.constants import GRPC, RPC
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("common.grpc_utils")
 
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
     ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
     ("grpc.keepalive_time_ms", GRPC.KEEPALIVE_TIME_MS),
     ("grpc.keepalive_timeout_ms", GRPC.KEEPALIVE_TIMEOUT_MS),
+    ("grpc.initial_reconnect_backoff_ms", GRPC.INITIAL_RECONNECT_BACKOFF_MS),
+    ("grpc.min_reconnect_backoff_ms", GRPC.MIN_RECONNECT_BACKOFF_MS),
+    ("grpc.max_reconnect_backoff_ms", GRPC.MAX_RECONNECT_BACKOFF_MS),
 ]
 
 
@@ -26,4 +45,221 @@ def build_server(max_workers: int = 64) -> grpc.Server:
     return grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Retrying call plane
+# ---------------------------------------------------------------------------
+
+#: Status codes that signal a transient condition worth retrying: the
+#: server was unreachable/restarting (UNAVAILABLE) or the deadline lapsed
+#: (DEADLINE_EXCEEDED).  Anything else (INVALID_ARGUMENT, INTERNAL, ...)
+#: is a real error and propagates immediately.
+TRANSIENT_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Per-RPC deadline + bounded exponential backoff.
+
+    `max_attempts=1` means deadline-only (the non-idempotent policy).
+    Backoff for attempt k (1-based) is
+    ``min(max_backoff_s, base_backoff_s * 2**(k-1))`` scaled by a
+    DETERMINISTIC jitter in [1, 1+jitter] seeded from (method, k) — no
+    wall-clock randomness, so a chaos run's schedule replays exactly.
+    `total_budget_s` bounds the whole call including backoff sleeps: a
+    retry that could not complete within the remaining budget is not
+    attempted (fail fast rather than overshoot).
+
+    `wait_for_ready` queues the RPC while the channel is disconnected
+    (up to the deadline) instead of failing instantly.  This matters for
+    outage ride-through beyond politeness: a pending RPC is what drives
+    gRPC to keep attempting the TCP reconnect — a tight fail-fast retry
+    loop over a TRANSIENT_FAILURE channel can spin for minutes after the
+    server is back without ever kicking a connection attempt (observed
+    with grpc 1.68; the chaos e2e would hang exactly that way).
+    """
+
+    timeout_s: float = RPC.DEADLINE_S
+    max_attempts: int = 1
+    base_backoff_s: float = RPC.BASE_BACKOFF_S
+    max_backoff_s: float = RPC.MAX_BACKOFF_S
+    jitter: float = RPC.JITTER
+    total_budget_s: float = RPC.TOTAL_BUDGET_S
+    wait_for_ready: bool = False
+
+    def backoff_s(self, method: str, attempt: int, salt: str = "") -> float:
+        base = min(
+            self.max_backoff_s, self.base_backoff_s * (2 ** (attempt - 1))
+        )
+        if not self.jitter:
+            return base
+        # Seeded by (salt, method, attempt) — deterministic across runs,
+        # and with a per-worker salt (worker id) the fleet's retry storm
+        # after a master restart is decorrelated instead of N workers
+        # hammering the recovering master in lockstep.
+        u = random.Random(f"{salt}:{method}:{attempt}").random()
+        return base * (1.0 + self.jitter * u)
+
+
+#: The two client-side policies.  Idempotency is a per-RPC property the
+#: caller declares (see worker/master_client.py); the wrapper never
+#: guesses.
+IDEMPOTENT_POLICY = RetryPolicy(
+    max_attempts=RPC.MAX_ATTEMPTS, wait_for_ready=True
+)
+NON_IDEMPOTENT_POLICY = RetryPolicy(max_attempts=1)
+
+
+@dataclass
+class RetryStats:
+    """Mutable per-client counters (observability + chaos-test asserts).
+    Lock-guarded: one MasterClient is shared by the task loop and the
+    heartbeat thread, and unsynchronized `+=` would drop counts."""
+
+    calls: int = 0
+    attempts: int = 0
+    retries: int = 0
+    give_ups: int = 0
+    last_error: str = ""
+    per_method_retries: dict = field(default_factory=dict)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def record_call(self):
+        with self._lock:
+            self.calls += 1
+
+    def record_attempt(self):
+        with self._lock:
+            self.attempts += 1
+
+    def record_retry(self, method: str):
+        with self._lock:
+            self.retries += 1
+            self.per_method_retries[method] = (
+                self.per_method_retries.get(method, 0) + 1
+            )
+
+    def record_give_up(self, method: str, code_name: str):
+        with self._lock:
+            self.give_ups += 1
+            self.last_error = f"{method}: {code_name}"
+
+
+class InjectedRpcError(grpc.RpcError):
+    """A fault-injected RPC failure (faults.py `rpc.*:error`).  Mimics the
+    subset of grpc.Call the retry wrapper and callers inspect."""
+
+    def __init__(self, code: grpc.StatusCode):
+        super().__init__(f"injected {code.name}")
+        self._code = code
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return "injected fault (elasticdl_tpu.common.faults)"
+
+
+def _apply_rpc_fault(spec: faults.FaultSpec, sleep: Callable[[float], None]):
+    if spec.kind == "error":
+        raise InjectedRpcError(
+            getattr(grpc.StatusCode, spec.arg or "UNAVAILABLE")
+        )
+    if spec.kind == "latency":
+        sleep(float(spec.arg or 0.1))
+
+
+def call_with_retry(
+    rpc_callable: Callable,
+    request,
+    method: str,
+    policy: RetryPolicy,
+    stats: Optional[RetryStats] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    seed: str = "",
+):
+    """Invoke `rpc_callable(request, timeout=...)` under `policy`.
+
+    Every attempt carries the policy's explicit deadline; transient
+    failures (TRANSIENT_CODES) back off and retry while attempts and the
+    total budget last.  The `rpc.<method>` fault-injection site fires
+    once per ATTEMPT (so `error=...@1x3` means "first three attempts
+    fail"), before the wire call, and is a no-op when faults are
+    disarmed.
+    """
+    if stats is not None:
+        stats.record_call()
+    deadline = clock() + policy.total_budget_s
+    attempt = 0
+    while True:
+        attempt += 1
+        if stats is not None:
+            stats.record_attempt()
+        try:
+            spec = faults.fire(f"rpc.{method}")
+            if spec is not None:
+                _apply_rpc_fault(spec, sleep)
+            return rpc_callable(
+                request,
+                timeout=policy.timeout_s,
+                wait_for_ready=policy.wait_for_ready,
+            )
+        except grpc.RpcError as exc:
+            code = exc.code() if callable(getattr(exc, "code", None)) else None
+            transient = code in TRANSIENT_CODES
+            backoff = policy.backoff_s(method, attempt, salt=seed)
+            # Reserve backoff AND the next attempt's full deadline: the
+            # budget is a hard bound on the whole call, so an attempt
+            # that could still be in flight past it is not started.
+            out_of_budget = clock() + backoff + policy.timeout_s > deadline
+            if (
+                not transient
+                or attempt >= policy.max_attempts
+                or out_of_budget
+            ):
+                if stats is not None and transient:
+                    stats.record_give_up(method, code and code.name)
+                if transient and policy.max_attempts > 1:
+                    logger.warning(
+                        "RPC %s failed with %s after %d attempt(s)%s",
+                        method,
+                        code and code.name,
+                        attempt,
+                        " (retry budget exhausted)" if out_of_budget else "",
+                    )
+                raise
+            if stats is not None:
+                stats.record_retry(method)
+            if attempt == 1:
+                # One line per outage, not per retry: the first retry
+                # announces the condition, the give-up (above) closes it.
+                logger.warning(
+                    "RPC %s hit %s; retrying with backoff (deadline %.0fs, "
+                    "budget %.0fs)",
+                    method,
+                    code and code.name,
+                    policy.timeout_s,
+                    policy.total_budget_s,
+                )
+            sleep(backoff)
+
+
+def expected_backoff_schedule(
+    method: str, policy: RetryPolicy, retries: int, seed: str = ""
+) -> Tuple[float, ...]:
+    """The exact backoff sequence `call_with_retry` will sleep for
+    `retries` consecutive transient failures of `method` under `seed`
+    (the caller's jitter salt, e.g. the worker id) — exported so tests
+    assert the schedule instead of re-deriving the jitter."""
+    return tuple(
+        policy.backoff_s(method, attempt, salt=seed)
+        for attempt in range(1, retries + 1)
     )

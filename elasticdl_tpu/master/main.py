@@ -215,6 +215,13 @@ def main(argv=None):
     fleet supervision, reference master-pod behavior); Local starts a bare
     master server for debugging.
     """
+    from elasticdl_tpu.common import faults
+
+    if faults.install_from_env():
+        logger.warning(
+            "Fault injection armed from %s=%r",
+            faults.ENV_VAR, os.environ.get(faults.ENV_VAR),
+        )
     args = parse_master_args(argv)
     if args.distribution_strategy != DistributionStrategy.LOCAL:
         from elasticdl_tpu.master.job_runner import run_allreduce_job, run_ps_job

@@ -123,6 +123,8 @@ class HeartbeatReporter:
     plane: the pod manager kills workers whose heartbeats go silent, which
     converts hangs into the process-exit signal churn handling reacts to)."""
 
+    WARN_INTERVAL_S = 60.0
+
     def __init__(
         self,
         master_client,
@@ -137,6 +139,11 @@ class HeartbeatReporter:
         self._host = host or advertised_host()
         self._interval_s = interval_s
         self._stop = threading.Event()
+        #: Consecutive/total failed heartbeats (tests and ops read these —
+        #: a silently-dead liveness plane looks exactly like a healthy one
+        #: from the worker side otherwise).
+        self.error_count = 0
+        self._last_warn_monotonic: Optional[float] = None
         self._thread = threading.Thread(
             target=self._loop, name="worker-heartbeat", daemon=True
         )
@@ -154,10 +161,25 @@ class HeartbeatReporter:
                 self._mc.report_worker_liveness(
                     self._host, self._world.rendezvous_id
                 )
-            except Exception:
-                # Master unreachable: nothing useful to do from here; the
-                # process manager side handles the failure.
-                pass
+            except Exception as exc:
+                # Master unreachable: the process-manager side owns the
+                # failure, but say so (rate-limited) — a heartbeat plane
+                # that swallows every error is indistinguishable from one
+                # that works, until the pod manager kills this "hung"
+                # worker for silence.
+                self.error_count += 1
+                now = time.monotonic()
+                if (
+                    self._last_warn_monotonic is None
+                    or now - self._last_warn_monotonic >= self.WARN_INTERVAL_S
+                ):
+                    self._last_warn_monotonic = now
+                    logger.warning(
+                        "Liveness heartbeat to master failed (%s: %s); "
+                        "%d failure(s) so far — the pod manager may kill "
+                        "this worker if heartbeats stay silent",
+                        type(exc).__name__, exc, self.error_count,
+                    )
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.constants import Mode, TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
@@ -218,10 +219,11 @@ class CollectiveWorker:
             if task.type == pb.WAIT:
                 time.sleep(self._wait_sleep_s)
                 continue
+            spec = faults.fire("worker.task")
+            if spec is not None and spec.kind == "crash":
+                faults.crash_now(spec)
             try:
                 counters = self._process_task(task)
-                if self._world.is_leader:
-                    self._mc.report_task_result(task.task_id, "", counters)
             except Exception as exc:
                 logger.error(
                     "Task %d failed on rank %d:\n%s",
@@ -230,16 +232,23 @@ class CollectiveWorker:
                     traceback.format_exc(),
                 )
                 if self._world.is_leader:
-                    try:
-                        self._mc.report_task_result(
-                            task.task_id, str(exc) or repr(exc)
-                        )
-                    except Exception:
-                        pass
+                    self._mc.report_task_result_best_effort(
+                        task.task_id, str(exc) or repr(exc)
+                    )
                 # A failed collective step likely poisons the world: die and
                 # let the pod manager re-form it (reference: Horovod
                 # shutdown/re-init on HorovodInternalError).
                 raise
+            else:
+                # The collective step SUCCEEDED on every rank; a lost
+                # success report is only an RPC-plane fault and must not
+                # escalate into restart-the-world.  The master requeues
+                # the unacked task (at-least-once) and the healthy world
+                # retrains it.
+                if self._world.is_leader:
+                    self._mc.report_task_result_best_effort(
+                        task.task_id, "", counters
+                    )
         self._report_version(force=True)
         self._maybe_checkpoint(force=True)
 

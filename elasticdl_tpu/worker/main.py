@@ -29,6 +29,13 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", forced)
+    from elasticdl_tpu.common import faults
+
+    if faults.install_from_env():
+        logger.warning(
+            "Fault injection armed from %s=%r",
+            faults.ENV_VAR, os.environ.get(faults.ENV_VAR),
+        )
     args = parse_worker_args(argv)
     if getattr(args, "jax_compilation_cache_dir", ""):
         import jax

@@ -1,33 +1,108 @@
 """Worker-side wrapper around the Master gRPC stub.
 
-Parity: elasticdl/python/worker/master_client.py in the reference.
+Parity: elasticdl/python/worker/master_client.py in the reference, plus the
+transient-failure plane: every RPC carries an explicit deadline, and
+idempotent RPCs (reads and naturally-deduplicated reports) retry transient
+failures with backoff so workers ride through a master restart instead of
+dying and triggering a slice-wide world re-formation.
+
+Idempotency per RPC (the retry wrapper never guesses — see
+common/grpc_utils.py):
+
+- `get_task`           retried: a popped-but-unacked task is recovered by
+                       the master's timeout/churn paths (at-least-once).
+- `get_comm_rank`, `report_worker_liveness`, `get_shard_checkpoint`
+                       retried: pure reads / latest-wins liveness.
+- `report_version`     retried: the master folds it with max().
+- `report_task_result` NOT retried: a duplicate success report for a
+                       task id the master already closed logs as
+                       unknown-task; a duplicate *failure* report would
+                       double-charge the task's retry budget.
+- `report_evaluation_metrics`
+                       NOT retried: reports append to the round's staged
+                       chunks — a duplicate would double-count rows.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import dataclasses
+import time
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
 from elasticdl_tpu.common import tensor_utils
-from elasticdl_tpu.common.grpc_utils import build_channel
+from elasticdl_tpu.common.constants import RPC
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.grpc_utils import (
+    IDEMPOTENT_POLICY,
+    NON_IDEMPOTENT_POLICY,
+    RetryPolicy,
+    RetryStats,
+    build_channel,
+    call_with_retry,
+)
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.proto.service import MasterStub
 
+logger = get_logger("worker.master_client")
+
 
 class MasterClient:
-    def __init__(self, addr: str, worker_id: int):
+    def __init__(
+        self,
+        addr: str,
+        worker_id: int,
+        retry_policy: Optional[RetryPolicy] = None,
+        no_retry_policy: Optional[RetryPolicy] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
         self._channel = build_channel(addr)
         self._stub = MasterStub(self._channel)
         self._worker_id = worker_id
+        self._retry_policy = retry_policy or IDEMPOTENT_POLICY
+        self._no_retry_policy = no_retry_policy or NON_IDEMPOTENT_POLICY
+        self._sleep = sleep
+        #: Transient-failure observability: how often this worker had to
+        #: retry (chaos tests assert workers actually rode through the
+        #: outage instead of never noticing it).
+        self.retry_stats = RetryStats()
 
     @property
     def worker_id(self) -> int:
         return self._worker_id
 
+    # ------------------------------------------------------------------
+
+    def _call(self, method: str, request, policy: RetryPolicy):
+        return call_with_retry(
+            getattr(self._stub, method),
+            request,
+            method=method,
+            policy=policy,
+            stats=self.retry_stats,
+            sleep=self._sleep,
+            # Per-worker jitter salt: deterministic per worker, but the
+            # fleet's backoff schedules are decorrelated.
+            seed=str(self._worker_id),
+        )
+
+    def _call_idempotent(self, method: str, request):
+        return self._call(method, request, self._retry_policy)
+
+    def _call_once(self, method: str, request, timeout_s: Optional[float] = None):
+        policy = self._no_retry_policy
+        if timeout_s is not None and timeout_s != policy.timeout_s:
+            # Override only the deadline; an injected no_retry_policy
+            # keeps its other fields.
+            policy = dataclasses.replace(policy, timeout_s=timeout_s)
+        return self._call(method, request, policy)
+
+    # ------------------------------------------------------------------
+
     def get_task(self, task_type: int = pb.TRAINING) -> pb.Task:
         request = pb.GetTaskRequest(worker_id=self._worker_id, task_type=task_type)
-        return self._stub.get_task(request).task
+        return self._call_idempotent("get_task", request).task
 
     def report_task_result(
         self, task_id: int, err_message: str = "", exec_counters: Optional[Dict[str, int]] = None
@@ -38,7 +113,27 @@ class MasterClient:
         if exec_counters:
             for key, value in exec_counters.items():
                 request.exec_counters[key] = int(value)
-        self._stub.report_task_result(request)
+        self._call_once("report_task_result", request)
+
+    def report_task_result_best_effort(
+        self, task_id: int, err_message: str = "",
+        exec_counters: Optional[Dict[str, int]] = None,
+    ) -> bool:
+        """Result report where delivery failure is data, not an error:
+        result reports are non-idempotent and never retried, and an
+        unreported task is recovered by the master's timeout/churn paths
+        (at-least-once) — so a report lost to a master outage must not
+        crash the worker or poison the world.  True when delivered."""
+        try:
+            self.report_task_result(task_id, err_message, exec_counters)
+            return True
+        except Exception:
+            logger.warning(
+                "Could not report task %d %s (master unreachable?); the "
+                "master will requeue the task (at-least-once)",
+                task_id, "failure" if err_message else "success",
+            )
+            return False
 
     def report_evaluation_metrics(self, model_version: int, model_outputs,
                                   labels, task_id: int = 0):
@@ -57,30 +152,39 @@ class MasterClient:
             request.labels.append(
                 tensor_utils.ndarray_to_pb(np.asarray(array), name=name)
             )
-        self._stub.report_evaluation_metrics(request)
+        self._call_once(
+            "report_evaluation_metrics",
+            request,
+            timeout_s=RPC.EVAL_REPORT_DEADLINE_S,
+        )
 
     def report_version(self, model_version: int):
-        self._stub.report_version(
+        self._call_idempotent(
+            "report_version",
             pb.ReportVersionRequest(
                 model_version=model_version, worker_id=self._worker_id
-            )
+            ),
         )
 
     def get_comm_rank(self, host: str = "") -> pb.GetCommRankResponse:
-        return self._stub.get_comm_rank(
-            pb.GetCommRankRequest(worker_id=self._worker_id, host=host)
+        return self._call_idempotent(
+            "get_comm_rank",
+            pb.GetCommRankRequest(worker_id=self._worker_id, host=host),
         )
 
     def report_worker_liveness(self, host: str, rendezvous_id: int) -> bool:
-        response = self._stub.report_worker_liveness(
+        response = self._call_idempotent(
+            "report_worker_liveness",
             pb.ReportWorkerLivenessRequest(
                 worker_id=self._worker_id, host=host, rendezvous_id=rendezvous_id
-            )
+            ),
         )
         return response.should_reset
 
     def get_shard_checkpoint(self) -> str:
-        return self._stub.get_shard_checkpoint(pb.ShardCheckpointRequest()).content
+        return self._call_idempotent(
+            "get_shard_checkpoint", pb.ShardCheckpointRequest()
+        ).content
 
     def close(self):
         self._channel.close()

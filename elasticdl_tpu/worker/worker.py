@@ -14,6 +14,7 @@ from typing import Optional
 
 import numpy as np
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.constants import Mode, TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
@@ -94,19 +95,31 @@ class Worker:
             if task.type == pb.WAIT:
                 time.sleep(self._wait_sleep_s)
                 continue
+            spec = faults.fire("worker.task")
+            if spec is not None and spec.kind == "crash":
+                faults.crash_now(spec)
             try:
                 counters = self._process_task(task)
-                self._mc.report_task_result(task.task_id, "", counters)
-                consecutive_failures = 0
             except Exception as exc:
                 logger.error("Task %d failed:\n%s", task.task_id, traceback.format_exc())
-                self._mc.report_task_result(task.task_id, str(exc) or repr(exc))
+                self._mc.report_task_result_best_effort(
+                    task.task_id, str(exc) or repr(exc)
+                )
                 consecutive_failures += 1
                 if consecutive_failures >= self._max_consecutive_failures:
                     raise RuntimeError(
                         f"{consecutive_failures} consecutive task failures; "
                         "worker aborting"
                     ) from exc
+            else:
+                # The task itself succeeded — a lost SUCCESS report must
+                # not morph into a failure report (it would requeue
+                # already-trained records AND double-charge the task's
+                # retry budget).
+                self._mc.report_task_result_best_effort(
+                    task.task_id, "", counters
+                )
+                consecutive_failures = 0
         # Final version report so master-side services see the last step.
         self._report_version(force=True)
 
@@ -140,6 +153,9 @@ class Worker:
         record_count = 0
         last_loss = None
         for features, labels in dataset:
+            spec = faults.fire("worker.step")
+            if spec is not None and spec.kind == "crash":
+                faults.crash_now(spec)
             if self._profiler is not None:
                 self._profiler.before_steps(self._trainer.step)
             last_loss = self._trainer.train_step(features, labels)

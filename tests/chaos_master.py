@@ -1,0 +1,92 @@
+"""Minimal master driver for the chaos e2e (tests/test_chaos.py).
+
+Runs ONLY the master control plane — TaskManager (+ resume from the
+persisted shard-progress snapshot), progress persister, and the gRPC
+servicer — with none of the model/jax imports, so a SIGKILL + restart
+cycle completes in a couple of seconds and the chaos test measures the
+workers' RPC-retry ride-through, not interpreter start-up.
+
+The real resume path through `build_master` is proved by
+tests/test_master_resume.py; this driver reuses the same persistence
+primitives (TaskProgressPersister.progress_path / from_checkpoint).
+
+Usage:
+    python tests/chaos_master.py CKPT_DIR PORT SHARD_NAME N_RECORDS \
+        RECORDS_PER_TASK NUM_EPOCHS
+
+Writes CKPT_DIR/MASTER_DONE when the job finishes:
+    {"resumed": bool, "resumed_finished_records": int,
+     "finished_records": int}
+"""
+
+import json
+import os
+import sys
+import time
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.master.servicer import MasterServicer, start_master_server
+from elasticdl_tpu.master.task_manager import TaskManager, TaskProgressPersister
+
+DONE_FILE = "MASTER_DONE"
+
+
+def main(argv):
+    ckpt_dir, port, shard_name = argv[0], int(argv[1]), argv[2]
+    n_records, records_per_task, num_epochs = (int(v) for v in argv[3:6])
+    faults.install_from_env()
+
+    resumed = False
+    resumed_finished = 0
+    task_manager = None
+    progress_path = TaskProgressPersister.progress_path(ckpt_dir)
+    if os.path.exists(progress_path):
+        with open(progress_path) as f:
+            task_manager = TaskManager.from_checkpoint(f.read())
+        resumed = True
+        resumed_finished = task_manager.finished_record_count
+    if task_manager is None:
+        task_manager = TaskManager(
+            training_shards={shard_name: n_records},
+            records_per_task=records_per_task,
+            num_epochs=num_epochs,
+        )
+
+    servicer = MasterServicer(task_manager=task_manager)
+    # The replacement master binds the SAME port its predecessor was
+    # SIGKILLed on; brief bind failures (straggling kernel state) retry.
+    bound = 0
+    for _ in range(40):
+        server, bound = start_master_server(servicer, port=port)
+        if bound == port:
+            break
+        server.stop(grace=None)
+        time.sleep(0.25)
+    if bound != port:
+        print(f"could not bind port {port}", file=sys.stderr)
+        return 3
+
+    persister = TaskProgressPersister(
+        task_manager, ckpt_dir, interval_s=0.1
+    ).start()
+    while not task_manager.finished():
+        time.sleep(0.02)
+    persister.stop()
+    with open(os.path.join(ckpt_dir, DONE_FILE), "w") as f:
+        json.dump(
+            {
+                "resumed": resumed,
+                "resumed_finished_records": resumed_finished,
+                "finished_records": task_manager.finished_record_count,
+            },
+            f,
+        )
+    # Linger so workers' final get_task (job-complete answer) and version
+    # reports land instead of hitting a stopping server.
+    time.sleep(3.0)
+    server.stop(grace=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

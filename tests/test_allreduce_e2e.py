@@ -6,6 +6,11 @@ across worker processes, kill one mid-job, assert the job still completes
 with every record trained (at-least-once task semantics).
 """
 
+import pytest
+
+# Tier-1 fast gate runs `-m 'not slow'` (see Makefile test-fast).
+pytestmark = [pytest.mark.slow, pytest.mark.e2e]
+
 import os
 import time
 

@@ -1,0 +1,343 @@
+"""Chaos suite: deterministic fault injection + a real master-outage e2e.
+
+The e2e is the tentpole proof: SIGKILL the master mid-job while two real
+workers hold in-flight tasks — the workers ride through the outage on the
+RPC retry plane (no worker dies, no restart-the-world), the replacement
+master (same port) resumes from the persisted shard-progress snapshot, and
+the job completes with every record of every epoch processed at least
+once.
+
+The checkpoint-plane tests drive the `ckpt.write:truncate` injection
+point: a torn write is detected by the CRC32 integrity manifest, the
+snapshot is quarantined (with a logged reason), and restore falls back to
+the previous step — it never crashes and never loads garbage.
+"""
+
+import contextlib
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.grpc_utils import RetryPolicy
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.master_client import MasterClient
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+@contextlib.contextmanager
+def capture_logs(logger_name):
+    """The framework root logger doesn't propagate (log_utils); attach a
+    recording handler directly."""
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger = logging.getLogger(logger_name)
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+
+
+# ---------------------------------------------------------------------------
+# Tentpole e2e: master SIGKILL mid-job, workers ride through on retries.
+# ---------------------------------------------------------------------------
+
+#: Snappy retry plane for a localhost outage measured in seconds.
+CHAOS_POLICY = RetryPolicy(
+    timeout_s=3.0,
+    max_attempts=400,
+    base_backoff_s=0.05,
+    max_backoff_s=0.25,
+    jitter=0.25,
+    total_budget_s=120.0,
+    wait_for_ready=True,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("localhost", 0))
+        return sock.getsockname()[1]
+
+
+class RecordingClient(MasterClient):
+    """MasterClient that records which (epoch, start, end) training ranges
+    this worker COMPLETED (result report accepted by a master)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.completed = []
+        self._inflight = {}
+
+    def get_task(self, task_type=pb.TRAINING):
+        task = super().get_task(task_type)
+        if task.task_id >= 0 and task.type == pb.TRAINING:
+            self._inflight[task.task_id] = (task.epoch, task.start, task.end)
+        return task
+
+    def report_task_result(self, task_id, err_message="", exec_counters=None):
+        super().report_task_result(task_id, err_message, exec_counters)
+        if not err_message and task_id in self._inflight:
+            self.completed.append(self._inflight.pop(task_id))
+
+
+def _start_master(ckpt_dir, port, shard_name, n_records, rpt, epochs, log_path):
+    repo_root = os.path.dirname(TESTS_DIR)
+    env = {**os.environ}
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH", "")) if p
+    )
+    with open(log_path, "ab") as log_file:
+        return subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(TESTS_DIR, "chaos_master.py"),
+                str(ckpt_dir), str(port), shard_name,
+                str(n_records), str(rpt), str(epochs),
+            ],
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+
+
+def test_master_sigkill_midjob_workers_ride_through(tmp_path):
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.common.model_utils import load_model_spec
+    from elasticdl_tpu.data.reader import build_data_reader
+    from elasticdl_tpu.worker.worker import Worker
+
+    n_records, rpt, epochs = 1024, 32, 2
+    port = _free_port()
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    master_log = str(tmp_path / "master.log")
+
+    args = parse_master_args([
+        "--model_zoo=model_zoo",
+        "--model_def=mnist.mnist_functional_api",
+        f"--training_data=synthetic://mnist?n={n_records}",
+        f"--records_per_task={rpt}",
+        "--minibatch_size=16",
+        f"--num_epochs={epochs}",
+    ])
+    model_spec = load_model_spec(args)
+    # The driver master serves the shard name the workers' reader expects.
+    reader = build_data_reader(args, model_spec, args.training_data)
+    (shard_name,) = reader.shard_names()
+
+    proc = _start_master(
+        ckpt_dir, port, shard_name, n_records, rpt, epochs, master_log
+    )
+    clients, workers, threads, errors = [], [], [], []
+    try:
+        for wid in range(2):
+            client = RecordingClient(
+                f"localhost:{port}", worker_id=wid, retry_policy=CHAOS_POLICY
+            )
+            clients.append(client)
+            workers.append(Worker(
+                master_client=client,
+                model_spec=model_spec,
+                data_reader=build_data_reader(
+                    args, model_spec, args.training_data
+                ),
+                minibatch_size=args.minibatch_size,
+                wait_sleep_s=0.1,
+            ))
+
+        def run(worker):
+            try:
+                worker.run()
+            except Exception as exc:  # noqa: BLE001 — the assert below
+                errors.append(exc)
+
+        for worker in workers:
+            thread = threading.Thread(target=run, args=(worker,), daemon=True)
+            thread.start()
+            threads.append(thread)
+
+        # Let real progress land — tasks completed AND a progress
+        # snapshot holding some of them persisted — with both workers
+        # mid-job...
+        def persisted_finished_records():
+            try:
+                with open(ckpt_dir / "task_progress.json") as f:
+                    return json.load(f).get("finished_record_count", 0)
+            except (OSError, ValueError):
+                return 0
+
+        deadline = time.time() + 300
+        while (
+            sum(len(c.completed) for c in clients) < 5
+            or persisted_finished_records() == 0
+        ):
+            assert time.time() < deadline, "no progress before the kill"
+            assert proc.poll() is None, "master died prematurely"
+            time.sleep(0.01)
+
+        # ... then SIGKILL the master.  Hold the outage open until both
+        # facts are on record: the workers actually RETRIED (an in-flight
+        # RPC died with UNAVAILABLE, or a wait_for_ready poll hit its
+        # deadline — a too-short outage can be absorbed by a single
+        # pending RPC with zero retries), and nothing died.
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        outage_deadline = time.time() + 30
+        while (
+            sum(c.retry_stats.retries for c in clients) == 0
+            and time.time() < outage_deadline
+        ):
+            time.sleep(0.05)
+        for thread in threads:
+            assert thread.is_alive(), "a worker died during the outage"
+
+        # Replacement master: same port, resumes the persisted snapshot.
+        proc = _start_master(
+            ckpt_dir, port, shard_name, n_records, rpt, epochs, master_log
+        )
+        for thread in threads:
+            thread.join(timeout=420)
+            assert not thread.is_alive(), "worker never finished after resume"
+        assert not errors, f"worker(s) crashed: {errors!r}"
+        assert proc.wait(timeout=120) == 0
+
+        # The replacement really RESUMED (did not restart the epoch).
+        with open(ckpt_dir / "MASTER_DONE") as f:
+            done = json.load(f)
+        assert done["resumed"] is True
+        assert done["resumed_finished_records"] > 0
+
+        # Workers rode through the outage on the retry plane.
+        assert sum(c.retry_stats.retries for c in clients) > 0
+
+        # No lost records: every record of BOTH epochs completed at least
+        # once across the two master generations (at-least-once).
+        for epoch in range(epochs):
+            covered = set()
+            for client in clients:
+                for ep, start, end in client.completed:
+                    if ep == epoch:
+                        covered.update(range(start, end))
+            assert covered == set(range(n_records)), (
+                f"gap in epoch {epoch}: "
+                f"{sorted(set(range(n_records)) - covered)[:10]}..."
+            )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        for client in clients:
+            client.close()
+        if os.path.exists(master_log):
+            sys.stderr.write(open(master_log).read()[-4000:])
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint plane: torn writes are quarantined, restore falls back.
+# ---------------------------------------------------------------------------
+
+
+def test_torn_checkpoint_write_quarantined_and_falls_back(tmp_path):
+    from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+    saver = CheckpointSaver(str(tmp_path), keep_max=5)
+    saver.save({"w": [1, 2, 3], "step": 1}, step=1)
+    faults.install("ckpt.write:truncate@1")  # tear the NEXT save
+    saver.save({"w": [4, 5, 6], "step": 2}, step=2)
+    faults.clear()
+
+    with capture_logs("elasticdl_tpu.checkpoint.saver") as records:
+        state, step = saver.load_latest()
+    # Fell back exactly one step; the torn snapshot never loaded.
+    assert step == 1
+    assert state == {"w": [1, 2, 3], "step": 1}
+    quarantined = [
+        n for n in os.listdir(tmp_path) if n.endswith(".quarantined")
+    ]
+    assert quarantined == ["step_000000000002.quarantined"]
+    messages = [r.getMessage() for r in records]
+    assert any("Quarantin" in m and "falling back" in m for m in messages)
+    # The quarantined snapshot is invisible to future restores/GC.
+    assert saver.steps() == [1]
+    # And a fresh save at the same step works (the dir name is free).
+    saver.save({"w": [7], "step": 2}, step=2)
+    state, step = saver.load_latest()
+    assert (step, state) == (2, {"w": [7], "step": 2})
+
+
+def test_sharded_torn_write_falls_back_one_step(tmp_path):
+    from elasticdl_tpu.checkpoint.sharded import ShardedCheckpointSaver
+
+    saver = ShardedCheckpointSaver(str(tmp_path), keep_max=5)
+    saver.save(1, {"dense": [1.0]}, sharded={})
+    faults.install("ckpt.write:truncate@1")
+    saver.save(2, {"dense": [2.0]}, sharded={})
+    faults.clear()
+
+    with capture_logs("elasticdl_tpu.checkpoint.saver") as records:
+        assert saver.latest_step() == 1
+    assert saver.load_dense(1) == {"dense": [1.0]}
+    assert any(
+        "Quarantin" in r.getMessage() for r in records
+    )
+    assert any(
+        n.endswith(".quarantined") for n in os.listdir(tmp_path)
+    )
+
+
+def test_unreadable_and_empty_step_dirs_are_skipped(tmp_path):
+    """Satellite: steps()/restore skip junk step dirs with a warning
+    instead of raising mid-listing."""
+    from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+    saver = CheckpointSaver(str(tmp_path), keep_max=5)
+    saver.save({"ok": True}, step=3)
+    os.makedirs(tmp_path / "step_000000000009")  # empty: no state file
+    (tmp_path / "step_000000000010").mkdir()
+    (tmp_path / "step_000000000010" / "state.pkl").write_bytes(b"")  # empty
+    (tmp_path / "step_notanumber").mkdir()
+
+    with capture_logs("elasticdl_tpu.checkpoint.saver") as records:
+        assert saver.steps() == [3]
+    assert sum(
+        "incomplete/unreadable" in r.getMessage() for r in records
+    ) == 2
+    state, step = saver.load_latest()
+    assert (step, state) == (3, {"ok": True})
+
+
+def test_crashed_save_tmp_dir_swept_at_startup(tmp_path):
+    """Satellite: stale .tmp dirs from crashed saves are garbage-collected
+    by the startup sweep; fresh ones (a live peer's save) are kept."""
+    from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+
+    stale = tmp_path / "step_000000000004.tmpabc"
+    stale.mkdir()
+    (stale / "state.pkl").write_bytes(b"partial")
+    old = time.time() - 7200
+    os.utime(stale, (old, old))
+    fresh = tmp_path / "step_000000000005.tmpdef"
+    fresh.mkdir()
+
+    saver = CheckpointSaver(str(tmp_path), keep_max=5)
+    assert not stale.exists(), "stale crashed-save tmp dir not swept"
+    assert fresh.exists(), "in-flight peer save must not be swept"
+    assert saver.steps() == []

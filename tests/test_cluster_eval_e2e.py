@@ -7,6 +7,11 @@ aggregated by the master's EvaluationService land in event files the TB
 reader can load.
 """
 
+import pytest
+
+# Tier-1 fast gate runs `-m 'not slow'` (see Makefile test-fast).
+pytestmark = [pytest.mark.slow, pytest.mark.e2e]
+
 import glob
 import os
 

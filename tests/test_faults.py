@@ -1,0 +1,138 @@
+"""Unit surface of the deterministic fault-injection registry
+(common/faults.py) and the checkpoint integrity-manifest helpers it
+perturbs (checkpoint/saver.py)."""
+
+import os
+import zlib
+
+import pytest
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.checkpoint.saver import (
+    file_crc32,
+    verify_integrity,
+    write_integrity_manifest,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_full_spec():
+    (spec,) = faults.parse_specs("rpc.get_task:error=UNAVAILABLE@3x2")
+    assert spec.site == "rpc.get_task"
+    assert spec.kind == "error"
+    assert spec.arg == "UNAVAILABLE"
+    assert (spec.after, spec.count) == (3, 2)
+
+
+def test_parse_defaults_and_forever():
+    one, forever = faults.parse_specs(
+        "ckpt.write:truncate, worker.task:crash=7x*"
+    )
+    assert (one.after, one.count, one.arg) == (1, 1, "")
+    assert (forever.after, forever.count, forever.arg) == (1, -1, "7")
+
+
+def test_parse_semicolon_separator_and_whitespace():
+    specs = faults.parse_specs(" rpc.a:latency=0.5 ; rpc.b:error ")
+    assert [s.site for s in specs] == ["rpc.a", "rpc.b"]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["rpc.a", "rpc.a:explode", "rpc.a:error@0", "rpc.a:errorx0", "rpc.a:error@x"],
+)
+def test_parse_rejects_malformed_specs(bad):
+    with pytest.raises(ValueError):
+        faults.parse_specs(bad)
+
+
+# ---------------------------------------------------------------------------
+# Trigger semantics
+# ---------------------------------------------------------------------------
+
+
+def test_fire_triggers_by_call_count_only():
+    faults.install("s:error@2x2")
+    hits = [faults.fire("s") is not None for _ in range(5)]
+    assert hits == [False, True, True, False, False]
+    assert faults.call_count("s") == 5
+
+
+def test_sites_count_independently():
+    faults.install("a:error@1")
+    assert faults.fire("a") is not None
+    assert faults.fire("b") is None
+    assert faults.call_count("a") == 1
+    assert faults.call_count("b") == 1
+
+
+def test_install_from_env_and_clear(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "x:latency=0.1@1")
+    assert faults.install_from_env()
+    assert faults.enabled()
+    faults.clear()
+    assert not faults.enabled()
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert not faults.install_from_env()
+
+
+def test_reinstall_resets_counters():
+    faults.install("s:error@1")
+    faults.fire("s")
+    faults.install("s:error@1")
+    assert faults.call_count("s") == 0
+    assert faults.fire("s") is not None
+
+
+# ---------------------------------------------------------------------------
+# Integrity manifest helpers
+# ---------------------------------------------------------------------------
+
+
+def test_file_crc32_matches_zlib(tmp_path):
+    payload = b"x" * (3 << 20) + b"tail"
+    path = tmp_path / "blob"
+    path.write_bytes(payload)
+    assert file_crc32(str(path)) == zlib.crc32(payload)
+
+
+def test_verify_integrity_passes_and_detects_each_corruption(tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"hello")
+    (tmp_path / "b.bin").write_bytes(b"world!")
+    write_integrity_manifest(str(tmp_path), ["a.bin", "b.bin"])
+    assert verify_integrity(str(tmp_path)) is None
+
+    # Same-size bit flip -> crc mismatch.
+    (tmp_path / "a.bin").write_bytes(b"hellO")
+    reason = verify_integrity(str(tmp_path))
+    assert reason is not None and "a.bin" in reason and "crc32" in reason
+
+    # Truncation -> size mismatch (reported as a torn write).
+    (tmp_path / "a.bin").write_bytes(b"hello")
+    (tmp_path / "b.bin").write_bytes(b"wor")
+    reason = verify_integrity(str(tmp_path))
+    assert reason is not None and "b.bin" in reason and "torn write" in reason
+
+    # Inventoried file missing from a committed dir: proven corruption.
+    os.unlink(tmp_path / "b.bin")
+    assert "missing" in verify_integrity(str(tmp_path))
+
+    # Garbage manifest: proven corruption (a torn manifest write).
+    (tmp_path / "b.bin").write_bytes(b"world!")
+    (tmp_path / "integrity.json").write_text("{not json")
+    assert "garbage" in verify_integrity(str(tmp_path))
+
+
+def test_verify_integrity_vacuous_without_manifest(tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"anything")
+    assert verify_integrity(str(tmp_path)) is None  # pre-integrity snapshot

@@ -7,6 +7,11 @@ Parity surface: SURVEY §2.2 data readers + §3.3 worker dataset assembly
 configs.
 """
 
+import pytest
+
+# Tier-1 fast gate runs `-m 'not slow'` (see Makefile test-fast).
+pytestmark = pytest.mark.slow
+
 import numpy as np
 import pytest
 

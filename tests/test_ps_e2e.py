@@ -5,6 +5,11 @@ the cross-process gather in lookups, the scatter in sparse apply, and the
 collective checkpoint gather, none of which single-process tests can see.
 """
 
+import pytest
+
+# Tier-1 fast gate runs `-m 'not slow'` (see Makefile test-fast).
+pytestmark = [pytest.mark.slow, pytest.mark.e2e]
+
 import os
 import time
 

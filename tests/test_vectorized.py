@@ -2,6 +2,11 @@
 buffer-level ETRF read path (native codec and Python fallback produce
 identical chunks; parse_buffer matches per-record parsing)."""
 
+import pytest
+
+# Tier-1 fast gate runs `-m 'not slow'` (see Makefile test-fast).
+pytestmark = pytest.mark.slow
+
 import numpy as np
 import pytest
 
