@@ -3,7 +3,8 @@
 # first use (pb2 is checked in; the native .so builds lazily); these
 # targets are the explicit developer entry points.
 
-.PHONY: all proto native test test-fast test-chaos e2e bench wheel clean
+.PHONY: all proto native test test-fast test-chaos e2e bench wheel clean \
+        lint check-invariants
 
 all: proto native test
 
@@ -18,10 +19,29 @@ native:
 test:
 	python -m pytest tests/ -q
 
-# Tier-1 fast gate: the correctness surface without the compile-heavy
-# `slow`-marked tests (pyproject registers the markers) — what CI and a
-# review session can finish on the 1-core box.
-test-fast:
+# Control-plane invariant analyzer (docs/invariants.md): every rule the
+# transient-failure design depends on, machine-checked.  Exit 1 on any
+# violation; suppress a deliberate exception with `# noqa-invariant: <rule>`.
+check-invariants:
+	python -m elasticdl_tpu.analysis
+
+# Static gate: ruff (errors-only baseline, config in pyproject.toml) when
+# available — the container may not ship it — then the invariant analyzer.
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check .; \
+	else \
+		echo "lint: ruff not installed; skipping style baseline" \
+		     "(F821/F401/E722 — see [tool.ruff] in pyproject.toml)"; \
+	fi
+	$(MAKE) check-invariants
+
+# Tier-1 fast gate: lint + invariants first (cheap, seconds), then the
+# correctness surface without the compile-heavy `slow`-marked tests
+# (pyproject registers the markers) — what CI and a review session can
+# finish on the 1-core box.  tests/test_analysis.py re-runs the invariant
+# pass inside pytest, so the plain pytest tier-1 command gates on it too.
+test-fast: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
 # Transient-failure resilience gate: deterministic fault injection

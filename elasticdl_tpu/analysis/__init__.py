@@ -1,0 +1,51 @@
+"""Machine-checked invariants for the elastic control plane.
+
+PR 1 made the control plane survive transient faults *by contract*:
+every RPC carries a deadline, only idempotent RPCs retry, fault
+injection is clock- and randomness-free, and the master services guard
+shared state behind locks (docs/failure_model.md).  This package turns
+those contracts into tooling:
+
+- a static AST analyzer (`python -m elasticdl_tpu.analysis`,
+  `make check-invariants`) with one checker per rule — see
+  `elasticdl_tpu.analysis.rules` and docs/invariants.md;
+- a runtime lock-order race detector (`elasticdl_tpu.analysis.runtime`)
+  armed by ``ELASTICDL_LOCKCHECK=1`` that records per-thread lock
+  acquisition order, flags lock-order inversions, and reports
+  suspiciously long hold times.
+
+Both are dependency-free (stdlib only) so the checks run on any box the
+code does, including the CI host with no accelerators.
+"""
+
+# Lazy exports (PEP 562): the production control plane imports
+# `elasticdl_tpu.analysis.runtime` (for make_lock) on every master start;
+# that must not drag the whole static analyzer (core/rules) into every
+# training process — and a broken analyzer edit must never be able to
+# stop the control plane from booting.
+_EXPORTS = {
+    "SourceFile": "core",
+    "Violation": "core",
+    "discover_files": "core",
+    "format_violations": "core",
+    "run_checks": "core",
+    "ALL_RULES": "rules",
+    "RULE_NAMES": "rules",
+}
+
+
+def __getattr__(name):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"{__name__}.{submodule}"), name
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,86 @@
+"""CLI: ``python -m elasticdl_tpu.analysis [paths...] [--rule NAME]``.
+
+Exit status: 0 when every invariant holds, 1 when violations were found,
+2 on usage errors.  With no paths, scans the installed ``elasticdl_tpu``
+package (the production control plane — tests are exercised separately
+by tests/test_analysis.py fixtures).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from elasticdl_tpu.analysis.core import (
+    discover_files,
+    format_violations,
+    run_checks,
+)
+from elasticdl_tpu.analysis.rules import ALL_RULES, RULE_NAMES
+
+
+def default_paths():
+    package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [package_dir]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.analysis",
+        description="Invariant analyzer for the elastic control plane "
+        "(docs/invariants.md).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to scan (default: the elasticdl_tpu "
+        "package)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        choices=RULE_NAMES,
+        help="run only this rule (repeatable; default: all rules)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name in RULE_NAMES:
+            doc = (ALL_RULES[name].__doc__ or "").strip().splitlines()
+            print(f"{name}: {doc[0] if doc else ''}")
+        return 0
+
+    rules = [ALL_RULES[name] for name in (args.rule or RULE_NAMES)]
+    paths = args.paths or default_paths()
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"error: no such path: {path}", file=sys.stderr)
+            return 2
+
+    if not discover_files(paths):
+        # An OK verdict over zero files is a false green gate (typoed
+        # directory, non-.py argument) — refuse instead.
+        print(f"error: no .py files found under: {' '.join(paths)}",
+              file=sys.stderr)
+        return 2
+
+    violations = run_checks(paths, rules)
+    if violations:
+        print(format_violations(violations))
+        print(
+            f"\n{len(violations)} invariant violation(s). "
+            "See docs/invariants.md (suppress a deliberate exception with "
+            "'# noqa-invariant: <rule>').",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"check-invariants: OK ({', '.join(r for r in (args.rule or RULE_NAMES))})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

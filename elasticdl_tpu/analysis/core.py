@@ -1,0 +1,188 @@
+"""Analyzer framework: file model, suppression, discovery, runner.
+
+A *rule* is a callable ``rule(source: SourceFile) -> List[Violation]``
+registered in `elasticdl_tpu.analysis.rules`.  This module owns
+everything rule-agnostic:
+
+- `SourceFile` parses a file once (AST + per-line comments) and is
+  shared by every rule;
+- inline suppression: a violation is dropped when its line (or the
+  statement's first line) carries ``# noqa-invariant: <rule>`` —
+  comma-separated rule names, or ``*`` for all rules;
+- `run_checks` walks the requested paths and returns violations sorted
+  by (path, line).
+
+Only stdlib imports: the analyzer must run on boxes where jax/grpc are
+not importable (pre-commit hooks, bare CI runners).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+#: Inline suppression marker, e.g. ``foo()  # noqa-invariant: rpc-deadline``
+_NOQA_RE = re.compile(r"#\s*noqa-invariant:\s*([\w*,\s-]+)")
+
+#: Inline guarded-field annotation, e.g.
+#: ``self._todo = deque()  # guarded-by: _lock`` — consumed by the
+#: lock-discipline rule, parsed here so SourceFile owns all comment IR.
+_GUARDED_INLINE_RE = re.compile(r"#\s*guarded-by:\s*(\w+)\s*$")
+
+#: Standalone multi-field form (subclasses re-declaring inherited fields):
+#: ``# guarded-by: _lock: _handles, _num_workers``
+_GUARDED_BLOCK_RE = re.compile(r"#\s*guarded-by:\s*(\w+)\s*:\s*([\w,\s]+)$")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed source file, shared across rules."""
+
+    path: str  # as given (normally repo-relative)
+    text: str
+    tree: ast.AST
+    #: line number -> set of suppressed rule names ("*" = all)
+    noqa: Dict[int, Set[str]] = field(default_factory=dict)
+    #: line number -> full comment text on that line (if any)
+    comments: Dict[int, str] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, text: Optional[str] = None) -> "SourceFile":
+        if text is None:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        tree = ast.parse(text, filename=path)
+        source = cls(path=path, text=text, tree=tree)
+        source._collect_comments()
+        return source
+
+    def _collect_comments(self):
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                line = tok.start[0]
+                self.comments[line] = tok.string
+                match = _NOQA_RE.search(tok.string)
+                if match:
+                    names = {
+                        name.strip()
+                        for name in match.group(1).split(",")
+                        if name.strip()
+                    }
+                    self.noqa.setdefault(line, set()).update(names)
+        except tokenize.TokenError:
+            pass  # AST parsed fine; comment-level features degrade
+
+    # -- comment-derived annotations ----------------------------------
+
+    def guarded_inline(self, line: int) -> Optional[str]:
+        """Lock name from an inline ``# guarded-by: <lock>`` on `line`."""
+        comment = self.comments.get(line)
+        if not comment:
+            return None
+        match = _GUARDED_INLINE_RE.search(comment)
+        return match.group(1) if match else None
+
+    def guarded_blocks(self, first_line: int, last_line: int) -> Dict[str, str]:
+        """field -> lock from standalone ``# guarded-by: <lock>: f1, f2``
+        comments between `first_line` and `last_line` (a class span)."""
+        mapping: Dict[str, str] = {}
+        for line in range(first_line, last_line + 1):
+            comment = self.comments.get(line)
+            if not comment:
+                continue
+            match = _GUARDED_BLOCK_RE.search(comment)
+            if not match:
+                continue
+            lock = match.group(1)
+            for name in match.group(2).split(","):
+                name = name.strip()
+                if name:
+                    mapping[name] = lock
+        return mapping
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        names = self.noqa.get(line)
+        return bool(names) and (rule in names or "*" in names)
+
+
+def discover_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list."""
+    found: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs if d not in ("__pycache__", ".git")
+                )
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        found.append(os.path.join(root, name))
+        elif path.endswith(".py"):
+            found.append(path)
+    return found
+
+
+def run_checks(
+    paths: Sequence[str],
+    rules: Iterable[Callable[[SourceFile], List[Violation]]],
+) -> List[Violation]:
+    """Run `rules` over every .py under `paths`; suppressions applied."""
+    rules = list(rules)
+    violations: List[Violation] = []
+    for file_path in discover_files(paths):
+        try:
+            source = SourceFile.parse(file_path)
+        except SyntaxError as exc:
+            violations.append(
+                Violation(
+                    rule="parse",
+                    path=file_path,
+                    line=exc.lineno or 0,
+                    col=exc.offset or 0,
+                    message=f"could not parse: {exc.msg}",
+                )
+            )
+            continue
+        except (OSError, UnicodeDecodeError, ValueError) as exc:
+            # Unreadable / non-UTF-8 source must fail the gate as a
+            # finding, not crash the whole pass with a traceback.
+            violations.append(
+                Violation(
+                    rule="parse",
+                    path=file_path,
+                    line=0,
+                    col=0,
+                    message=f"could not read: {exc}",
+                )
+            )
+            continue
+        for rule in rules:
+            for violation in rule(source):
+                if not source.suppressed(violation.rule, violation.line):
+                    violations.append(violation)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
+
+
+def format_violations(violations: Sequence[Violation]) -> str:
+    return "\n".join(v.format() for v in violations)

@@ -170,7 +170,17 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "Megatron-style tensor parallelism with "
         "--model_params model_axis_mode=tp",
     )
-    parser.add_argument("--task_timeout_s", type=non_neg_int, default=0)
+    parser.add_argument(
+        "--task_timeout_s", type=non_neg_int, default=900,
+        help="Requeue a dispatched task not reported done within this "
+        "many seconds (0 disables). Nonzero by default as the liveness "
+        "backstop for a LOST dispatch: get_task retries on "
+        "DEADLINE_EXCEEDED, so a reply that died on the wire leaves the "
+        "popped task in `doing` with no worker-crash to recover it — "
+        "without a timeout the job would hang at job-end waiting on it "
+        "forever. At-least-once semantics make a spurious requeue of a "
+        "genuinely-slow task safe (it just re-runs).",
+    )
     parser.add_argument(
         "--jax_compilation_cache_dir", default="",
         help="Persistent XLA compilation cache directory (shared across "

@@ -40,6 +40,9 @@ trigger (default 1, `x*` = every call from `after` on).
 
 from __future__ import annotations
 
+# deterministic-replay-path — the invariant analyzer bans wall-clock and
+# unseeded-RNG reads in this module (docs/invariants.md, rule `determinism`).
+
 import os
 import threading
 from dataclasses import dataclass, field

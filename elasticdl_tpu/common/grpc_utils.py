@@ -121,12 +121,12 @@ class RetryStats:
     Lock-guarded: one MasterClient is shared by the task loop and the
     heartbeat thread, and unsynchronized `+=` would drop counts."""
 
-    calls: int = 0
-    attempts: int = 0
-    retries: int = 0
-    give_ups: int = 0
-    last_error: str = ""
-    per_method_retries: dict = field(default_factory=dict)
+    calls: int = 0  # guarded-by: _lock
+    attempts: int = 0  # guarded-by: _lock
+    retries: int = 0  # guarded-by: _lock
+    give_ups: int = 0  # guarded-by: _lock
+    last_error: str = ""  # guarded-by: _lock
+    per_method_retries: dict = field(default_factory=dict)  # guarded-by: _lock
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )

@@ -9,11 +9,11 @@ of the round's tasks have reported, and the raw batches are then dropped.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.log_utils import get_logger
 
@@ -32,32 +32,33 @@ class EvaluationService:
         self._eval_metrics_fn = eval_metrics_fn
         self._evaluation_steps = evaluation_steps
         self._tensorboard_service = tensorboard_service
-        self._lock = threading.Lock()
-        self._last_eval_version = -1
-        # Per in-flight round (keyed by model_version):
-        self._reported: Dict[int, List] = {}   # list of (outputs dict, labels)
+        self._lock = make_lock("EvaluationService._lock")
+        self._last_eval_version = -1  # guarded-by: _lock
+        # Per in-flight round (keyed by model_version), each value a
+        # list of (outputs dict, labels) batches:
+        self._reported: Dict[int, List] = {}  # guarded-by: _lock
         # Chunked reports STAGE per (model_version, task_id) and promote
         # into the round only when that task COMPLETES: task ids are
         # fresh per attempt, so a failed/timed-out attempt's partial
         # chunks are simply never promoted (no double-counted rows on
         # at-least-once retry).
-        self._staged: Dict[tuple, List] = {}
+        self._staged: Dict[tuple, List] = {}  # guarded-by: _lock
         # A round finalizes when all its EVALUATION tasks COMPLETE (task-
         # manager callback) — NOT when a report count is reached: workers
         # flush several chunked metric reports per task (the eval-memory
         # bound, collective_worker.EVAL_REPORT_BATCHES), and each task's
         # chunks all precede its completion report on the worker's
         # synchronous gRPC channel.
-        self._expected_tasks: Dict[int, int] = {}
-        self._completed_tasks: Dict[int, int] = {}
+        self._expected_tasks: Dict[int, int] = {}  # guarded-by: _lock
+        self._completed_tasks: Dict[int, int] = {}  # guarded-by: _lock
         if task_manager is not None and hasattr(
             task_manager, "add_eval_task_done_callback"
         ):
             task_manager.add_eval_task_done_callback(self._on_eval_task_done)
         # Rounds already finalized: late/duplicate reports (possible under
         # at-least-once task retry) are dropped, not resurrected.
-        self._finalized_versions: set = set()
-        self._latest_metrics: Dict[str, float] = {}
+        self._finalized_versions: set = set()  # guarded-by: _lock
+        self._latest_metrics: Dict[str, float] = {}  # guarded-by: _lock
 
     # ------------------------------------------------------------------
     # Scheduling

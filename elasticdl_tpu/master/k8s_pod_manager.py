@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.master.k8s_client import (
     ApiError,
@@ -119,15 +120,18 @@ class KubernetesPodManager(ElasticWorkerManager):
         self._pod_startup_timeout_s = pod_startup_timeout_s
 
         self._selector = job_label_selector(self._job_name, "worker")
-        self._state_lock = threading.Lock()
-        self._pod_states: Dict[str, _PodState] = {}
-        self._we_deleted: set = set()
-        self._created_at: Dict[str, float] = {}
+        # Inherited supervision fields this substrate also mutates keep
+        # the base class's lock discipline:
+        # guarded-by: _lock: _handles, _next_worker_id, _num_workers
+        self._state_lock = make_lock("KubernetesPodManager._state_lock")
+        self._pod_states: Dict[str, _PodState] = {}  # guarded-by: _state_lock
+        self._we_deleted: set = set()  # guarded-by: _state_lock
+        self._created_at: Dict[str, float] = {}  # guarded-by: _state_lock
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
-        self._resource_version = ""
-        self._probe_handles: List[PodHandle] = []
-        self._probe_started = 0.0
+        self._resource_version = ""  # watch thread only (single writer)
+        self._probe_handles: List[PodHandle] = []  # guarded-by: _lock
+        self._probe_started = 0.0  # monitor thread only (single writer)
 
     # ------------------------------------------------------------------
     # Watch thread: API-server events -> pod status cache
@@ -537,13 +541,17 @@ class KubernetesPodManager(ElasticWorkerManager):
             if hasattr(self._scale_up_check_fn, "succeeded"):
                 self._scale_up_check_fn.succeeded()
             with self._lock:
-                if self._stopped:
-                    self._substrate_terminate(probe)
-                    return True
-                self._handles = []
+                stopped = self._stopped
+                if not stopped:
+                    self._handles = []
+                    self._num_workers = grown
+            if stopped:
+                # Terminate outside the lock: pod deletion blocks on the
+                # API server and must not stall other lock holders.
+                self._substrate_terminate(probe)
+                return True
             self._recover_world_tasks(handles)
             self._substrate_terminate(handles + probe)
-            self._num_workers = grown
             self._launch_world(grown)
             return True
         if (

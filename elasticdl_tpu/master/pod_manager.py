@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("master.pod_manager")
@@ -69,7 +70,7 @@ class ElasticWorkerManager:
         target_num_workers: Optional[int] = None,
         scale_up_check_fn: Optional[Callable[[int], int]] = None,
     ):
-        self._num_workers = num_workers
+        self._num_workers = num_workers  # guarded-by: _lock
         self._worker_argv_fn = worker_argv_fn
         self._rendezvous = rendezvous
         self._task_manager = task_manager
@@ -86,17 +87,17 @@ class ElasticWorkerManager:
         )
         # Elastic scale-up: the world may shrink under churn; when capacity
         # returns (scale_up_check_fn says so), grow back toward the target.
-        self._target_num_workers = (
+        self._target_num_workers = (  # guarded-by: _lock
             target_num_workers if target_num_workers is not None else num_workers
         )
         self._scale_up_check_fn = scale_up_check_fn
 
-        self._lock = threading.Lock()
-        self._handles: List = []
-        self._next_worker_id = 0
-        self._restarts_used = 0
-        self._stopped = False
-        self._failed_reason: Optional[str] = None
+        self._lock = make_lock("ElasticWorkerManager._lock")
+        self._handles: List = []  # guarded-by: _lock
+        self._next_worker_id = 0  # guarded-by: _lock
+        self._restarts_used = 0  # guarded-by: _lock
+        self._stopped = False  # guarded-by: _lock
+        self._failed_reason: Optional[str] = None  # guarded-by: _lock
         self._done_event = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
 
@@ -185,8 +186,11 @@ class ElasticWorkerManager:
         logger.info("Scaling world to %d workers", num_workers)
         self._recover_world_tasks(handles)
         self._substrate_terminate(handles)
-        self._num_workers = num_workers
-        self._target_num_workers = max(self._target_num_workers, num_workers)
+        with self._lock:
+            # scale() is an external-caller entry point racing the monitor
+            # thread's churn/regrow writes to the same sizing fields.
+            self._num_workers = num_workers
+            self._target_num_workers = max(self._target_num_workers, num_workers)
         self._launch_world(num_workers)
 
     # ------------------------------------------------------------------
@@ -227,8 +231,8 @@ class ElasticWorkerManager:
             self._monitor_loop_inner()
         except Exception as exc:  # never die silently: wait() must unblock
             logger.exception("Pod-manager monitor crashed")
-            self._failed_reason = f"pod-manager monitor crashed: {exc}"
             with self._lock:
+                self._failed_reason = f"pod-manager monitor crashed: {exc}"
                 self._stopped = True
                 handles = list(self._handles)
             self._substrate_terminate(handles)
@@ -309,9 +313,9 @@ class ElasticWorkerManager:
             if self._stopped:
                 return True
             self._handles = []
+            self._num_workers = new_size
         self._recover_world_tasks(handles)
         self._substrate_terminate(handles)
-        self._num_workers = new_size
         self._launch_world(new_size)
         return True
 
@@ -332,14 +336,14 @@ class ElasticWorkerManager:
         self._substrate_terminate(handles)  # survivors die with the world
         new_size = old_size if budget_left else old_size - 1
         if new_size < 1:
-            self._failed_reason = (
-                f"restart budget exhausted ({self._restarts_used - 1} used) "
-                "and no workers left"
-            )
-            logger.error("Job failed: %s", self._failed_reason)
-            self._done_event.set()
             with self._lock:
+                self._failed_reason = reason = (
+                    f"restart budget exhausted ({self._restarts_used - 1} "
+                    "used) and no workers left"
+                )
                 self._stopped = True
+            logger.error("Job failed: %s", reason)
+            self._done_event.set()
             return
         logger.info(
             "Re-forming world: %d -> %d workers (restart %d/%d)",

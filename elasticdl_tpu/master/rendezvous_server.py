@@ -25,10 +25,10 @@ from __future__ import annotations
 
 import os
 import socket
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
@@ -54,14 +54,14 @@ class ElasticRendezvous:
     """Single source of truth for "the current world"."""
 
     def __init__(self, coordinator_port_fn=find_free_port):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ElasticRendezvous._lock")
         self._coordinator_port_fn = coordinator_port_fn
-        self._rendezvous_id = 0
+        self._rendezvous_id = 0  # guarded-by: _lock
         # worker_id (sorted) -> rank; host of rank 0 hosts the coordinator.
-        self._workers: List[Tuple[int, str]] = []  # [(worker_id, host)]
-        self._coordinator_addr = ""
-        self._last_heartbeat: Dict[int, Optional[float]] = {}
-        self._world_declared_at = time.time()
+        self._workers: List[Tuple[int, str]] = []  # guarded-by: _lock
+        self._coordinator_addr = ""  # guarded-by: _lock
+        self._last_heartbeat: Dict[int, Optional[float]] = {}  # guarded-by: _lock
+        self._world_declared_at = time.time()  # guarded-by: _lock
 
     # ------------------------------------------------------------------
     # Master/pod-manager side

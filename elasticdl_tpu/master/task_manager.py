@@ -22,6 +22,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.constants import TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -88,7 +89,7 @@ class TaskManager:
         task_timeout_s: float = 0.0,
         max_task_retries: int = 3,
     ):
-        self._lock = threading.Lock()
+        self._lock = make_lock("TaskManager._lock")
         self._training_shards = dict(training_shards or {})
         self._evaluation_shards = dict(evaluation_shards or {})
         self._prediction_shards = dict(prediction_shards or {})
@@ -97,24 +98,24 @@ class TaskManager:
         self._task_timeout_s = task_timeout_s
         self._max_task_retries = max_task_retries
 
-        self._todo: deque = deque()
-        self._doing: Dict[int, Tuple[int, _Task, float]] = {}
-        self._task_id = 0
-        self._epoch = 0
-        self._finished_record_count = 0
-        self._recovered_record_count = 0
+        self._todo: deque = deque()  # guarded-by: _lock
+        self._doing: Dict[int, Tuple[int, _Task, float]] = {}  # guarded-by: _lock
+        self._task_id = 0  # guarded-by: _lock
+        self._epoch = 0  # guarded-by: _lock
+        self._finished_record_count = 0  # guarded-by: _lock
+        self._recovered_record_count = 0  # guarded-by: _lock
         # Aggregated exec counters reported by workers (e.g. batch_count).
-        self._exec_counters: Dict[str, int] = {}
+        self._exec_counters: Dict[str, int] = {}  # guarded-by: _lock
         # Tasks dropped after exhausting their retry budget.
-        self._permanently_failed: List[_Task] = []
-        self._tasks_done_callbacks: List[Callable[[], None]] = []
-        self._done_callbacks_fired = False
+        self._permanently_failed: List[_Task] = []  # guarded-by: _lock
+        self._tasks_done_callbacks: List[Callable[[], None]] = []  # guarded-by: _lock
+        self._done_callbacks_fired = False  # guarded-by: _lock
         # True while done-callbacks are running (they queue final-eval /
         # TRAIN_END tasks); get() must answer WAIT, not job-complete, until
         # they finish, or a second worker could exit before those tasks land.
-        self._finalizing = False
-        self._epoch_done_callbacks: List[Callable[[int], None]] = []
-        self._eval_task_done_callbacks: List[Callable[[int, int], None]] = []
+        self._finalizing = False  # guarded-by: _lock
+        self._epoch_done_callbacks: List[Callable[[int], None]] = []  # guarded-by: _lock
+        self._eval_task_done_callbacks: List[Callable[[int, int], None]] = []  # guarded-by: _lock
 
         if self._training_shards:
             self._create_training_tasks_locked()

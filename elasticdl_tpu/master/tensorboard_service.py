@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
+from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("master.tensorboard")
@@ -41,10 +42,12 @@ class TensorBoardService:
         self._model_version_fn = model_version_fn
         self._restarts_fn = restarts_fn
         self._sample_interval_s = sample_interval_s
-        self._lock = threading.Lock()
+        # Guards the (not thread-safe) event-file writer: scalars arrive
+        # from servicer threads, the sampler thread, and close().
+        self._lock = make_lock("TensorBoardService._lock")
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._writer = None
+        self._writer = None  # guarded-by: _lock
         try:
             from torch.utils.tensorboard import SummaryWriter
 

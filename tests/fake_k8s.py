@@ -165,7 +165,7 @@ class FakeK8sApiServer:
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+            target=self._httpd.serve_forever, name="fake-k8s-api", daemon=True
         )
 
     # -- lifecycle ------------------------------------------------------

@@ -1,0 +1,450 @@
+"""Unit tests for the invariant analyzer (elasticdl_tpu.analysis).
+
+One must-pass + must-fail fixture pair per rule, the inline-suppression
+contract, and the two repo-level acceptance gates:
+
+- the production tree is invariant-clean (`python -m elasticdl_tpu.analysis`
+  exits 0) — this test IS the tier-1 wiring of `make check-invariants`;
+- a seeded violation of each of the five rules makes the CLI exit
+  non-zero.
+"""
+
+import textwrap
+
+from elasticdl_tpu.analysis.__main__ import main as analysis_main
+from elasticdl_tpu.analysis.core import SourceFile, run_checks
+from elasticdl_tpu.analysis.rules import ALL_RULES, RULE_NAMES
+
+
+def violations(text, rule, path="fixture.py"):
+    source = SourceFile.parse(path, textwrap.dedent(text))
+    found = [
+        v
+        for v in ALL_RULES[rule](source)
+        if not source.suppressed(v.rule, v.line)
+    ]
+    assert all(v.rule == rule for v in found)
+    return found
+
+
+# ---------------------------------------------------------------------------
+# rpc-deadline
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_deadline_flags_raw_stub_call():
+    found = violations(
+        """
+        def f(self, req):
+            return self._stub.get_task(req)
+        """,
+        "rpc-deadline",
+    )
+    assert len(found) == 1 and "timeout" in found[0].message
+
+
+def test_rpc_deadline_flags_getattr_dispatch():
+    found = violations(
+        """
+        def f(stub, method, req):
+            return getattr(stub, method)(req)
+        """,
+        "rpc-deadline",
+    )
+    assert len(found) == 1
+
+
+def test_rpc_deadline_accepts_explicit_timeout_and_wrappers():
+    found = violations(
+        """
+        def f(self, req):
+            self._stub.get_task(req, timeout=10.0)
+            return call_with_retry(
+                getattr(self._stub, "get_task"), req,
+                method="get_task", policy=IDEMPOTENT_POLICY,
+            )
+        """,
+        "rpc-deadline",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# idempotency
+# ---------------------------------------------------------------------------
+
+
+def test_idempotency_flags_retried_result_report():
+    found = violations(
+        """
+        def f(self, req):
+            self._call_idempotent("report_task_result", req)
+        """,
+        "idempotency",
+    )
+    assert len(found) == 1 and "report_task_result" in found[0].message
+
+
+def test_idempotency_flags_call_with_retry_on_eval_report():
+    found = violations(
+        """
+        def f(fn, req):
+            call_with_retry(fn, req, "report_evaluation_metrics",
+                            IDEMPOTENT_POLICY)
+        """,
+        "idempotency",
+    )
+    assert len(found) == 1
+
+
+def test_idempotency_accepts_no_retry_policies():
+    found = violations(
+        """
+        def f(self, fn, req):
+            call_with_retry(fn, req, "report_task_result",
+                            NON_IDEMPOTENT_POLICY)
+            call_with_retry(fn, req, "report_task_result",
+                            self._no_retry_policy)
+            call_with_retry(fn, req, "report_task_result",
+                            RetryPolicy(max_attempts=1))
+            self._call_idempotent("get_task", req)
+        """,
+        "idempotency",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_flags_wall_clock_and_unseeded_rng():
+    found = violations(
+        """
+        # deterministic-replay-path
+        import random, time, datetime
+
+        def f():
+            a = time.time()
+            b = random.random()
+            c = datetime.now()
+            d = random.Random()
+            return a, b, c, d
+        """,
+        "determinism",
+    )
+    assert len(found) == 4
+
+
+def test_determinism_accepts_monotonic_and_seeded_rng():
+    found = violations(
+        """
+        # deterministic-replay-path
+        import random, time
+
+        def f(seed):
+            a = time.monotonic()
+            b = random.Random(seed).random()
+            time.sleep(0.1)
+            return a, b
+        """,
+        "determinism",
+    )
+    assert found == []
+
+
+def test_determinism_applies_by_path_suffix():
+    text = "import time\nx = time.time()\n"
+    assert violations(text, "determinism",
+                      path="elasticdl_tpu/common/faults.py")
+    assert not violations(text, "determinism", path="somewhere_else.py")
+
+
+def test_determinism_allows_seeded_rng_reads_inside_backoff():
+    # The real backoff jitter pattern from grpc_utils must stay legal.
+    found = violations(
+        """
+        # deterministic-replay-path
+        import random
+
+        def backoff(salt, method, attempt):
+            return random.Random(f"{salt}:{method}:{attempt}").random()
+        """,
+        "determinism",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# thread-hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_thread_hygiene_flags_missing_name_and_daemon():
+    found = violations(
+        """
+        import threading
+
+        def f(target):
+            threading.Thread(target=target)
+            threading.Thread(target=target, daemon=True)
+            threading.Thread(target=target, name="ok")
+        """,
+        "thread-hygiene",
+    )
+    assert len(found) == 3
+    assert "name, daemon" in found[0].message
+
+
+def test_thread_hygiene_accepts_named_daemon_threads():
+    found = violations(
+        """
+        import threading
+        from threading import Thread
+
+        def f(target):
+            threading.Thread(target=target, name="w", daemon=True)
+            Thread(target=target, name="w2", daemon=False)
+        """,
+        "thread-hygiene",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS = """
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._free = 0  # no annotation: unguarded
+
+    def good(self):
+        with self._lock:
+            self._items.append(1)
+            self._count += 1
+        self._free = 9
+
+    def good_via_locked_helper(self):
+        with self._lock:
+            self._refill_locked()
+
+    def _refill_locked(self):
+        self._items.extend([1, 2])
+        self._items[0] = 3
+
+    def bad_assign(self):
+        self._count = 5
+
+    def bad_mutator(self):
+        self._items.append(1)
+
+    def bad_subscript(self):
+        self._items[0] = 1
+
+    def bad_nested_thread_target(self):
+        with self._lock:
+            def target():
+                self._items.pop()  # lock NOT held when target() runs
+            return target
+"""
+
+
+def test_lock_discipline_flags_off_lock_mutations_only():
+    found = violations(_LOCKED_CLASS, "lock-discipline")
+    lines = {v.line for v in found}
+    bad_methods = {"bad_assign", "bad_mutator", "bad_subscript"}
+    assert len(found) == 4  # three bad_* methods + the nested closure
+    assert all(
+        any(m in v.message for m in bad_methods | {"bad_nested_thread_target"})
+        for v in found
+    )
+    assert lines  # every violation is anchored to a line
+
+
+def test_lock_discipline_dataclass_fields_and_named_locks():
+    found = violations(
+        """
+        import threading
+        from dataclasses import dataclass, field
+
+
+        @dataclass
+        class Stats:
+            calls: int = 0  # guarded-by: _meta_lock
+            _meta_lock: threading.Lock = field(default_factory=threading.Lock)
+
+            def good(self):
+                with self._meta_lock:
+                    self.calls += 1
+
+            def bad(self):
+                self.calls += 1
+
+            def wrong_lock(self):
+                with self._other:
+                    self.calls += 1
+        """,
+        "lock-discipline",
+    )
+    assert len(found) == 2
+    assert all("_meta_lock" in v.message for v in found)
+
+
+def test_lock_discipline_standalone_block_for_inherited_fields():
+    found = violations(
+        """
+        class Sub(Base):
+            def __init__(self):
+                super().__init__()
+                # guarded-by: _lock: _handles, _size
+
+            def bad(self):
+                self._size = 3
+
+            def good(self):
+                with self._lock:
+                    self._handles = []
+        """,
+        "lock-discipline",
+    )
+    assert len(found) == 1 and "_size" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# Suppression
+# ---------------------------------------------------------------------------
+
+
+def test_noqa_invariant_suppresses_by_rule_and_star():
+    found = violations(
+        """
+        import threading
+
+        def f(target):
+            threading.Thread(target=target)  # noqa-invariant: thread-hygiene
+            threading.Thread(target=target)  # noqa-invariant: *
+            threading.Thread(target=target)  # noqa-invariant: rpc-deadline
+        """,
+        "thread-hygiene",
+    )
+    assert len(found) == 1  # only the wrong-rule suppression still flags
+
+
+# ---------------------------------------------------------------------------
+# Repo-level gates (this is the tier-1 wiring of `make check-invariants`)
+# ---------------------------------------------------------------------------
+
+
+def test_production_tree_is_invariant_clean(capsys):
+    assert analysis_main([]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_production_annotations_actually_engage():
+    """Guard against the analyzer rotting into a no-op: the TaskManager
+    must expose guarded fields the lock-discipline rule sees."""
+    import ast
+
+    from elasticdl_tpu.analysis.rules import _collect_guarded_fields
+    from elasticdl_tpu.master import task_manager
+
+    source = SourceFile.parse(task_manager.__file__)
+    guarded = {}
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "TaskManager":
+            guarded = _collect_guarded_fields(source, node)
+    assert "_todo" in guarded and guarded["_todo"] == "_lock"
+    assert "_doing" in guarded
+
+
+_SEEDED_VIOLATIONS = {
+    "rpc-deadline": "def f(s, r):\n    return s._stub.get(r)\n",
+    "idempotency": (
+        "def f(s, r):\n"
+        "    s._call_idempotent('report_task_result', r)\n"
+    ),
+    "determinism": (
+        "# deterministic-replay-path\n"
+        "import time\n"
+        "def f():\n"
+        "    return time.time()\n"
+    ),
+    "thread-hygiene": (
+        "import threading\n"
+        "def f(t):\n"
+        "    threading.Thread(target=t)\n"
+    ),
+    "lock-discipline": (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._x = 0  # guarded-by: _lock\n"
+        "    def bad(self):\n"
+        "        self._x = 1\n"
+    ),
+}
+
+
+def test_cli_exits_nonzero_on_each_seeded_rule_violation(tmp_path, capsys):
+    """Acceptance: `make check-invariants` fails on a violation of EACH of
+    the five rules."""
+    assert set(_SEEDED_VIOLATIONS) == set(RULE_NAMES)
+    for rule, text in _SEEDED_VIOLATIONS.items():
+        bad = tmp_path / f"{rule.replace('-', '_')}.py"
+        bad.write_text(text)
+        rc = analysis_main([str(bad)])
+        out = capsys.readouterr().out
+        assert rc == 1, f"seeded {rule} violation not caught"
+        assert f"[{rule}]" in out
+
+
+def test_cli_rule_filter_and_listing(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_SEEDED_VIOLATIONS["thread-hygiene"])
+    assert analysis_main([str(bad), "--rule", "rpc-deadline"]) == 0
+    assert analysis_main([str(bad), "--rule", "thread-hygiene"]) == 1
+    capsys.readouterr()
+    assert analysis_main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for rule in RULE_NAMES:
+        assert rule in listed
+
+
+def test_run_checks_reports_unparseable_files(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    found = run_checks([str(tmp_path)], ALL_RULES.values())
+    assert len(found) == 1 and found[0].rule == "parse"
+
+
+def test_cli_refuses_zero_file_scan(tmp_path, capsys):
+    """An OK over zero scanned files would be a false green gate."""
+    empty = tmp_path / "empty_dir"
+    empty.mkdir()
+    assert analysis_main([str(empty)]) == 2
+    assert "no .py files" in capsys.readouterr().err
+
+
+def test_run_checks_reports_undecodable_files(tmp_path):
+    bad = tmp_path / "latin.py"
+    bad.write_bytes(b"# caf\xe9\nx = 1\n")
+    found = run_checks([str(tmp_path)], ALL_RULES.values())
+    assert len(found) == 1 and found[0].rule == "parse"
+    assert "could not read" in found[0].message
+
+
+def test_list_rules_has_descriptions(capsys):
+    assert analysis_main(["--list-rules"]) == 0
+    for line in capsys.readouterr().out.strip().splitlines():
+        rule, _, description = line.partition(":")
+        assert description.strip(), f"rule {rule} listed without a description"

@@ -169,8 +169,11 @@ def test_master_sigkill_midjob_workers_ride_through(tmp_path):
             except Exception as exc:  # noqa: BLE001 — the assert below
                 errors.append(exc)
 
-        for worker in workers:
-            thread = threading.Thread(target=run, args=(worker,), daemon=True)
+        for wid, worker in enumerate(workers):
+            thread = threading.Thread(
+                target=run, args=(worker,),
+                name=f"chaos-worker-{wid}", daemon=True,
+            )
             thread.start()
             threads.append(thread)
 

@@ -239,7 +239,12 @@ class TestCheckpoint:
             except Exception as exc:  # pragma: no cover
                 errors.append(exc)
 
-        threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+        threads = [
+            threading.Thread(
+                target=run, args=(i,), name=f"tm-worker-{i}", daemon=True
+            )
+            for i in range(8)
+        ]
         for thread in threads:
             thread.start()
         for thread in threads:
