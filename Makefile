@@ -3,8 +3,8 @@
 # first use (pb2 is checked in; the native .so builds lazily); these
 # targets are the explicit developer entry points.
 
-.PHONY: all proto native test test-fast test-chaos e2e bench wheel clean \
-        lint check-invariants
+.PHONY: all proto native test test-fast test-chaos test-obs e2e bench \
+        wheel clean lint check-invariants
 
 all: proto native test
 
@@ -43,6 +43,12 @@ lint:
 # pass inside pytest, so the plain pytest tier-1 command gates on it too.
 test-fast: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# Observability plane gate (docs/observability.md): registry semantics +
+# lockcheck concurrency, exporter endpoint round-trip, journal rotation,
+# and the master end-to-end acceptance scrape.
+test-obs:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
 
 # Transient-failure resilience gate: deterministic fault injection
 # (common/faults.py) + the master-SIGKILL / torn-checkpoint chaos e2e.

@@ -473,6 +473,127 @@ def _scan_method(source, cls, method, guarded, lock_names, violations):
 
 
 # ---------------------------------------------------------------------------
+# Rule: metric-label-cardinality
+# ---------------------------------------------------------------------------
+
+#: Label names whose values are unbounded in an elastic job: task ids
+#: grow forever, pods/hosts churn with every re-formation, steps/epochs
+#: are counters.  Each distinct label value is a NEW timeseries held
+#: forever by the registry and re-sent on every scrape — an unbounded
+#: label is a slow memory leak and a scrape-size bomb.  Such identifiers
+#: belong in the event journal (obs/journal.py) as free-form fields.
+UNBOUNDED_LABEL_NAMES = frozenset(
+    {
+        "task_id", "worker_id", "pod", "pod_name", "host", "hostname",
+        "addr", "address", "ip", "uid", "step", "epoch", "rendezvous_id",
+        "shard", "shard_name", "path", "job_name", "model_version",
+    }
+)
+
+#: Metric-creation entry points: the obs module helpers (receiver must
+#: look like a metrics registry, see _is_metric_factory) and the class
+#: forms (labelnames check only — `collections.Counter(...)` has no
+#: labelnames kwarg, so the class form cannot false-positive on it).
+_METRIC_FACTORY_HELPERS = frozenset({"counter", "gauge", "histogram"})
+_METRIC_FACTORY_CLASSES = frozenset({"Counter", "Gauge", "Histogram"})
+
+#: Receiver names that identify a metrics registry (`obs.counter`,
+#: `registry.histogram`, `self._registry.gauge`, ...).
+_METRIC_RECEIVER_HINTS = ("obs", "registry", "metrics")
+
+#: Metric methods that accept **label kwargs.
+_LABELED_METRIC_METHODS = frozenset(
+    {"labels", "inc", "dec", "set", "observe", "set_function"}
+)
+
+
+def _call_func_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def _is_metric_factory(node: ast.Call) -> bool:
+    """True for metric-creation helper calls.  Bare names (`counter(...)`)
+    and unresolvable receivers (`obs.registry().counter(...)`) count; a
+    resolvable receiver must carry a registry-ish name, so unrelated
+    `.histogram()`/`.counter()` methods on other objects stay unflagged."""
+    name = _call_func_name(node)
+    if name not in _METRIC_FACTORY_HELPERS:
+        return False
+    if isinstance(node.func, ast.Name):
+        return True
+    base = _dotted(node.func.value)
+    if base is None:
+        return True
+    last = base.split(".")[-1].lstrip("_").lower()
+    return any(hint in last for hint in _METRIC_RECEIVER_HINTS)
+
+
+def check_metric_label_cardinality(source: SourceFile) -> List[Violation]:
+    """Metric label sets stay bounded: no task/pod/host-shaped labels, no
+    dynamic metric names."""
+    violations = []
+
+    def flag(node, message):
+        violations.append(
+            Violation(
+                rule="metric-label-cardinality",
+                path=source.path,
+                line=node.lineno,
+                col=node.col_offset,
+                message=message,
+            )
+        )
+
+    for node in ast.walk(source.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func_name = _call_func_name(node)
+        is_helper = _is_metric_factory(node)
+        if is_helper or func_name in _METRIC_FACTORY_CLASSES:
+            if is_helper:
+                name_arg = _get_arg(node, 0, "name")
+                if isinstance(name_arg, (ast.JoinedStr, ast.BinOp)):
+                    flag(
+                        node,
+                        "dynamic metric name at metric-creation site — "
+                        "every distinct value mints a new metric family "
+                        "held forever; use a constant name and bounded "
+                        "labels (put the varying identifier in the event "
+                        "journal)",
+                    )
+            labelnames = _get_arg(node, 2, "labelnames")
+            if isinstance(labelnames, (ast.Tuple, ast.List, ast.Set)):
+                for elt in labelnames.elts:
+                    value = _string_value(elt)
+                    if value and value.lower() in UNBOUNDED_LABEL_NAMES:
+                        flag(
+                            elt,
+                            f"label '{value}' declared at metric creation "
+                            "is fed from an unbounded value source (task "
+                            "ids / pods / hosts grow without bound): every "
+                            "distinct value is a new timeseries held "
+                            "forever — record it as a journal field "
+                            "instead",
+                        )
+        if func_name in _LABELED_METRIC_METHODS:
+            for kw in node.keywords:
+                if kw.arg and kw.arg.lower() in UNBOUNDED_LABEL_NAMES:
+                    flag(
+                        kw.value,
+                        f"metric label '{kw.arg}' at a .{func_name}() call "
+                        "site carries an unbounded value (task ids / pods "
+                        "/ hosts): every distinct value is a new "
+                        "timeseries held forever — record it as a journal "
+                        "field instead",
+                    )
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -482,6 +603,7 @@ ALL_RULES = {
     "determinism": check_determinism,
     "thread-hygiene": check_thread_hygiene,
     "lock-discipline": check_lock_discipline,
+    "metric-label-cardinality": check_metric_label_cardinality,
 }
 
 RULE_NAMES = tuple(ALL_RULES)

@@ -33,10 +33,37 @@ import time
 import zlib
 from typing import Any, Dict, Optional, Tuple
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("checkpoint.saver")
+
+
+def _ckpt_metrics():
+    """Checkpoint-plane registry handles (get-or-create; shared with the
+    sharded saver and the master's task-progress persister)."""
+    return (
+        obs.histogram(
+            "elasticdl_checkpoint_save_duration_seconds",
+            "Checkpoint write latency, by checkpoint kind",
+            labelnames=("kind",),
+        ),
+        obs.histogram(
+            "elasticdl_checkpoint_restore_duration_seconds",
+            "Checkpoint restore latency, by checkpoint kind",
+            labelnames=("kind",),
+        ),
+        obs.counter(
+            "elasticdl_checkpoint_saves_total",
+            "Checkpoints committed, by checkpoint kind",
+            labelnames=("kind",),
+        ),
+        obs.counter(
+            "elasticdl_checkpoint_quarantines_total",
+            "Corrupt checkpoints quarantined (integrity failures)",
+        ),
+    )
 
 _STATE_FILE = "state.pkl"
 _INTEGRITY_FILE = "integrity.json"
@@ -205,6 +232,7 @@ class CheckpointSaver:
         a CRC32 integrity manifest covering the state file."""
         import jax
 
+        start = time.monotonic()
         host_state = jax.device_get(state)
         final_dir = self._step_dir(step)
         if os.path.exists(final_dir):
@@ -218,6 +246,10 @@ class CheckpointSaver:
         write_integrity_manifest(tmp_dir, [_STATE_FILE])
         _apply_write_fault(state_path)
         os.rename(tmp_dir, final_dir)
+        save_hist, _restore, saves, _quarantines = _ckpt_metrics()
+        save_hist.observe(time.monotonic() - start, kind="full")
+        saves.inc(kind="full")
+        obs.journal().record("checkpoint_saved", step=step, kind="full")
         logger.info("Saved checkpoint at step %d -> %s", step, final_dir)
         self._garbage_collect()
         return final_dir
@@ -226,6 +258,7 @@ class CheckpointSaver:
         """Returns (state, step); (None, 0) when no checkpoint exists.
         Corrupt snapshots (checksum mismatch or unreadable pickle) are
         quarantined and the next-newest good one wins."""
+        start = time.monotonic()
         for step in reversed(self.steps()):
             step_dir = self._step_dir(step)
             try:
@@ -245,6 +278,13 @@ class CheckpointSaver:
             try:
                 with open(path, "rb") as f:
                     state = pickle.load(f)
+                _save, restore_hist, _saves, _q = _ckpt_metrics()
+                restore_hist.observe(
+                    time.monotonic() - start, kind="full"
+                )
+                obs.journal().record(
+                    "checkpoint_restored", step=step, kind="full"
+                )
                 logger.info("Restored checkpoint from step %d", step)
                 return state, step
             except OSError:
@@ -283,6 +323,11 @@ class CheckpointSaver:
             "Quarantining corrupt checkpoint %s -> %s (%s); falling back "
             "to the previous step",
             step_dir, target, reason,
+        )
+        _save, _restore, _saves, quarantines = _ckpt_metrics()
+        quarantines.inc()
+        obs.journal().record(
+            "checkpoint_quarantined", path=step_dir, reason=reason
         )
         try:
             os.rename(step_dir, target)

@@ -41,9 +41,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.checkpoint.saver import (
     CheckpointSaver,
     _apply_write_fault,
+    _ckpt_metrics,
     verify_integrity,
     write_integrity_manifest,
 )
@@ -122,6 +124,9 @@ class ShardedCheckpointSaver(CheckpointSaver):
         only its own addressable rows of each `sharded` array.  Replicated
         arrays (tables too small to split) are written by rank 0 alone.
         `dense_state` may be None on ranks != 0 (only rank 0 writes it)."""
+        import time
+
+        start = time.monotonic()
         process = jax.process_index()
         n_processes = jax.process_count()
         final_dir = self._step_dir(step)
@@ -197,6 +202,15 @@ class ShardedCheckpointSaver(CheckpointSaver):
             except OSError:
                 if not os.path.exists(final_dir):
                     raise
+            save_hist, _restore, saves, _q = _ckpt_metrics()
+            save_hist.observe(time.monotonic() - start, kind="sharded")
+            saves.inc(kind="sharded")
+            obs.journal().record(
+                "checkpoint_saved",
+                step=step,
+                kind="sharded",
+                n_processes=n_processes,
+            )
             logger.info(
                 "Saved sharded checkpoint at step %d (%d arrays, %d procs)",
                 step,

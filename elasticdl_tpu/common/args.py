@@ -203,6 +203,13 @@ def add_cluster_arguments(parser: argparse.ArgumentParser):
     parser.add_argument("--master_port", type=non_neg_int, default=0,
                         help="0 picks a free port")
     parser.add_argument("--worker_pod_priority", default="")
+    parser.add_argument(
+        "--metrics_port", type=non_neg_int, default=None,
+        help="Embed the observability exporter in the master on this "
+        "port, serving /metrics (Prometheus text exposition), /healthz, "
+        "and /debug/vars (JSON metric dump + event-journal tail). "
+        "0 picks a free port (logged); omit to disable.",
+    )
     parser.add_argument("--max_worker_restarts", type=non_neg_int, default=3)
     parser.add_argument("--namespace", default="default")
     parser.add_argument("--image_name", default="")

@@ -20,11 +20,33 @@ from typing import Callable, Optional, Tuple
 
 import grpc
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.constants import GRPC, RPC
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("common.grpc_utils")
+
+#: Process-wide RPC retry-plane counters: every RetryStats instance also
+#: feeds these, so retries/give-ups appear on the obs exporter's /metrics
+#: alongside the rest of the control plane (RetryStats keeps the
+#: per-client view the chaos tests assert on).
+_RPC_CALLS = obs.counter(
+    "elasticdl_rpc_calls_total", "Client RPC calls entered"
+)
+_RPC_ATTEMPTS = obs.counter(
+    "elasticdl_rpc_attempts_total", "Client RPC wire attempts"
+)
+_RPC_RETRIES = obs.counter(
+    "elasticdl_rpc_retries_total",
+    "Transient-failure retries, by RPC method",
+    labelnames=("method",),
+)
+_RPC_GIVE_UPS = obs.counter(
+    "elasticdl_rpc_give_ups_total",
+    "Retry budgets exhausted, by RPC method",
+    labelnames=("method",),
+)
 
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
@@ -119,7 +141,18 @@ NON_IDEMPOTENT_POLICY = RetryPolicy(max_attempts=1)
 class RetryStats:
     """Mutable per-client counters (observability + chaos-test asserts).
     Lock-guarded: one MasterClient is shared by the task loop and the
-    heartbeat thread, and unsynchronized `+=` would drop counts."""
+    heartbeat thread, and unsynchronized `+=` would drop counts.
+
+    Every record also feeds the process-wide obs registry counters, and
+    retry traffic folds into a RATE-LIMITED periodic summary: one INFO
+    line per `SUMMARY_INTERVAL_S` with the retries/give-ups since the
+    last line, instead of per-event warnings (the first-retry outage
+    announcement and give-up close-out in `call_with_retry` remain — they
+    bracket an outage; this line quantifies the steady drizzle between).
+    """
+
+    #: Seconds between retry-summary INFO lines (5 minutes).
+    SUMMARY_INTERVAL_S = 300.0
 
     calls: int = 0  # guarded-by: _lock
     attempts: int = 0  # guarded-by: _lock
@@ -130,14 +163,25 @@ class RetryStats:
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
+    # Summary-window baselines (deltas since the last summary line).
+    _summary_started: Optional[float] = field(
+        default=None, repr=False, compare=False
+    )  # guarded-by: _lock
+    _summary_retries: int = field(default=0, repr=False, compare=False)  # guarded-by: _lock
+    _summary_give_ups: int = field(default=0, repr=False, compare=False)  # guarded-by: _lock
+    _summary_per_method: dict = field(
+        default_factory=dict, repr=False, compare=False
+    )  # guarded-by: _lock
 
     def record_call(self):
         with self._lock:
             self.calls += 1
+        _RPC_CALLS.inc()
 
     def record_attempt(self):
         with self._lock:
             self.attempts += 1
+        _RPC_ATTEMPTS.inc()
 
     def record_retry(self, method: str):
         with self._lock:
@@ -145,11 +189,61 @@ class RetryStats:
             self.per_method_retries[method] = (
                 self.per_method_retries.get(method, 0) + 1
             )
+        _RPC_RETRIES.inc(method=method)
 
     def record_give_up(self, method: str, code_name: str):
         with self._lock:
             self.give_ups += 1
             self.last_error = f"{method}: {code_name}"
+        _RPC_GIVE_UPS.inc(method=method)
+
+    def maybe_log_summary(
+        self, now: Optional[float] = None, interval_s: Optional[float] = None
+    ):
+        """Emit at most one INFO summary line per interval covering the
+        retry/give-up traffic since the previous line.  `now` is a
+        monotonic-clock reading (injectable for tests; never wall clock —
+        this module is on the deterministic-replay path)."""
+        now = time.monotonic() if now is None else now
+        interval = self.SUMMARY_INTERVAL_S if interval_s is None else interval_s
+        line = None
+        with self._lock:
+            if self._summary_started is None:
+                # First retry-plane event opens the window; no line yet.
+                self._summary_started = now
+                self._summary_retries = self.retries
+                self._summary_give_ups = self.give_ups
+                self._summary_per_method = dict(self.per_method_retries)
+                return
+            if now - self._summary_started < interval:
+                return
+            retries_delta = self.retries - self._summary_retries
+            give_ups_delta = self.give_ups - self._summary_give_ups
+            per_method = {
+                method: count - self._summary_per_method.get(method, 0)
+                for method, count in self.per_method_retries.items()
+                if count - self._summary_per_method.get(method, 0) > 0
+            }
+            elapsed = now - self._summary_started
+            self._summary_started = now
+            self._summary_retries = self.retries
+            self._summary_give_ups = self.give_ups
+            self._summary_per_method = dict(self.per_method_retries)
+            if retries_delta or give_ups_delta:
+                top = ", ".join(
+                    f"{method}={count}"
+                    for method, count in sorted(
+                        per_method.items(), key=lambda kv: -kv[1]
+                    )[:5]
+                )
+                line = (
+                    f"RPC retry summary: {retries_delta} retries, "
+                    f"{give_ups_delta} give-ups in the last "
+                    f"{elapsed / 60:.1f} min"
+                    + (f" (by method: {top})" if top else "")
+                )
+        if line:
+            logger.info(line)
 
 
 class InjectedRpcError(grpc.RpcError):
@@ -227,6 +321,7 @@ def call_with_retry(
             ):
                 if stats is not None and transient:
                     stats.record_give_up(method, code and code.name)
+                    stats.maybe_log_summary(now=clock())
                 if transient and policy.max_attempts > 1:
                     logger.warning(
                         "RPC %s failed with %s after %d attempt(s)%s",
@@ -238,6 +333,7 @@ def call_with_retry(
                 raise
             if stats is not None:
                 stats.record_retry(method)
+                stats.maybe_log_summary(now=clock())
             if attempt == 1:
                 # One line per outage, not per retry: the first retry
                 # announces the condition, the give-up (above) closes it.

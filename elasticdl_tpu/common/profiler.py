@@ -20,6 +20,7 @@ silently capturing nothing.
 
 from __future__ import annotations
 
+import atexit
 import os
 from typing import Optional
 
@@ -64,6 +65,14 @@ class StepProfiler:
         )
         self._tracing = False
         self._done = False
+        if window:
+            # Shutdown-path flush: a worker that exits (or is preempted)
+            # mid-window would otherwise never reach the task loop's
+            # stop() and lose the whole trace.  atexit + the worker
+            # main's SIGTERM->SystemExit conversion flush a PARTIAL trace
+            # instead; stop() is idempotent, so the normal path is
+            # unaffected.
+            atexit.register(self.stop)
 
     def before_steps(self, current_step: int, n: int = 1):
         """About to run steps current_step+1 .. current_step+n: start the
@@ -108,6 +117,10 @@ class StepProfiler:
             self.stop()
 
     def stop(self):
+        # Drop the shutdown hook first (bound-method equality): repeated
+        # in-process construction (tests, e2e harnesses) must not pin
+        # every historical profiler until interpreter exit.
+        atexit.unregister(self.stop)
         if not self._tracing:
             return
         import jax

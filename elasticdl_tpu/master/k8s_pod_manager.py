@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.master.k8s_client import (
@@ -59,13 +60,17 @@ class PodHandle:
 
 
 class _PodState:
-    __slots__ = ("phase", "exit_code", "deleted", "pod_ip", "uid")
+    __slots__ = ("phase", "exit_code", "deleted", "pod_ip", "uid",
+                 "timeout_reported")
 
     def __init__(self, uid: str = ""):
         self.phase = "Pending"
         self.exit_code: Optional[int] = None
         self.deleted = False
         self.pod_ip = ""
+        # Pending-timeout observability fires once per pod even though
+        # poll keeps returning the synthetic exit code until churn lands.
+        self.timeout_reported = False
         # uid of the pod *this manager created* under the name; events
         # carrying a different uid belong to a stale namesake (409-replace,
         # predecessor sweep races) and must not clobber this state.
@@ -129,6 +134,12 @@ class KubernetesPodManager(ElasticWorkerManager):
         self._created_at: Dict[str, float] = {}  # guarded-by: _state_lock
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
+        self._m_pod_failures = obs.counter(
+            "elasticdl_pod_failures_total",
+            "Worker-pod failures the substrate itself observed, by cause "
+            "(exit-code churn is counted by the relaunch counter)",
+            labelnames=("cause",),
+        )
         self._resource_version = ""  # watch thread only (single writer)
         self._probe_handles: List[PodHandle] = []  # guarded-by: _lock
         self._probe_started = 0.0  # monitor thread only (single writer)
@@ -323,6 +334,10 @@ class KubernetesPodManager(ElasticWorkerManager):
                 # Leave the handle in place; poll will surface the failure
                 # as churn and the budget decides what happens next.
                 logger.error("Creating pod %s failed: %s", name, e)
+                self._m_pod_failures.inc(cause="create_error")
+                obs.journal().record(
+                    "pod_create_failed", pod=name, error=str(e)
+                )
                 with self._state_lock:
                     state = self._pod_states.setdefault(name, _PodState())
                     state.phase = "Failed"
@@ -415,6 +430,16 @@ class KubernetesPodManager(ElasticWorkerManager):
                 handle.name,
                 self._pod_startup_timeout_s,
             )
+            with self._state_lock:
+                report = not state.timeout_reported
+                state.timeout_reported = True
+            if report:
+                self._m_pod_failures.inc(cause="pending_timeout")
+                obs.journal().record(
+                    "pod_pending_timeout",
+                    pod=handle.name,
+                    timeout_s=self._pod_startup_timeout_s,
+                )
             return PREEMPTED_EXIT_CODE
         return None
 

@@ -13,6 +13,7 @@ import sys
 from dataclasses import dataclass
 from typing import Optional
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.common.args import parse_master_args
 from elasticdl_tpu.common.constants import DistributionStrategy
 from elasticdl_tpu.common.log_utils import get_logger
@@ -38,12 +39,19 @@ class Master:
     data_reader: object = None
     progress_persister: object = None
     tensorboard_service: object = None
+    metrics_exporter: object = None
 
     @property
     def addr(self) -> str:
         return f"localhost:{self.port}"
 
     def stop(self):
+        if self.metrics_exporter is not None:
+            try:
+                self.metrics_exporter.stop()
+            except Exception:
+                logger.exception("Metrics exporter stop failed")
+            self.metrics_exporter = None
         if self.tensorboard_service is not None:
             try:
                 self.tensorboard_service.close()
@@ -61,6 +69,17 @@ class Master:
 
 
 def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
+    # Event journal first: everything the assembly below does (task
+    # creation, resume, rendezvous) should land on the timeline.  It
+    # lives next to the TensorBoard events it complements; checkpoint_dir
+    # is the fallback so cluster jobs without TensorBoard still journal.
+    journal_dir = getattr(args, "tensorboard_log_dir", "") or getattr(
+        args, "checkpoint_dir", ""
+    )
+    if journal_dir:
+        journal_path = obs.init_journal(journal_dir)
+        logger.info("Event journal -> %s", journal_path)
+
     model_spec = model_spec or load_model_spec(args)
 
     training_reader = None
@@ -193,6 +212,29 @@ def start_master(args, model_spec=None, rendezvous_server=None) -> Master:
     master = build_master(args, model_spec, rendezvous_server)
     master.server, master.port = start_master_server(
         master.servicer, port=args.master_port
+    )
+    metrics_port = getattr(args, "metrics_port", None)
+    if metrics_port is not None:
+        from elasticdl_tpu.obs.exporter import MetricsExporter
+
+        try:
+            master.metrics_exporter = MetricsExporter(
+                port=metrics_port
+            ).start()
+        except OSError:
+            # Observability must never take the control plane down: a
+            # taken port degrades to no exporter, not a dead master.
+            logger.exception(
+                "Metrics exporter could not bind port %d; continuing "
+                "without /metrics", metrics_port,
+            )
+    obs.journal().record(
+        "master_start",
+        job_name=args.job_name,
+        port=master.port,
+        metrics_port=(
+            master.metrics_exporter.port if master.metrics_exporter else None
+        ),
     )
     return master
 

@@ -32,10 +32,18 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("master.pod_manager")
+
+
+def _exit_reason(code) -> str:
+    """Bounded relaunch-cause label from a worker exit code: 137 / -9 is
+    the SIGKILL convention (preemption, OOM-kill, our own stale-worker
+    kill); anything else nonzero is a crash."""
+    return "preempted" if code in (137, -9) else "crash"
 
 
 class ElasticWorkerManager:
@@ -100,6 +108,25 @@ class ElasticWorkerManager:
         self._failed_reason: Optional[str] = None  # guarded-by: _lock
         self._done_event = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
+        self._m_relaunches = obs.counter(
+            "elasticdl_worker_relaunches_total",
+            "Worker relaunches within world re-formations, by cause",
+            labelnames=("reason",),
+        )
+        self._m_hung_kills = obs.counter(
+            "elasticdl_hung_worker_kills_total",
+            "Workers killed for silent heartbeats (hang -> churn)",
+        )
+        # Gauge callbacks read fields without the manager lock: a scrape
+        # must never couple the exporter to the supervision lock, and the
+        # len()/int reads are atomic enough for a monitoring sample.
+        obs.gauge(
+            "elasticdl_workers_target",
+            "Worker count the elastic manager is trying to reach",
+        ).set_function(lambda: self._target_num_workers)
+        obs.gauge(
+            "elasticdl_workers_actual", "Workers currently launched"
+        ).set_function(lambda: len(self._handles))
 
     # ------------------------------------------------------------------
     # Substrate hooks
@@ -191,6 +218,10 @@ class ElasticWorkerManager:
             # thread's churn/regrow writes to the same sizing fields.
             self._num_workers = num_workers
             self._target_num_workers = max(self._target_num_workers, num_workers)
+        self._m_relaunches.inc(num_workers, reason="scale")
+        obs.journal().record(
+            "scale", old_size=len(handles), new_size=num_workers
+        )
         self._launch_world(num_workers)
 
     # ------------------------------------------------------------------
@@ -235,6 +266,9 @@ class ElasticWorkerManager:
                 self._failed_reason = f"pod-manager monitor crashed: {exc}"
                 self._stopped = True
                 handles = list(self._handles)
+            obs.journal().record(
+                "job_failed", reason=f"pod-manager monitor crashed: {exc}"
+            )
             self._substrate_terminate(handles)
             self._done_event.set()
 
@@ -261,6 +295,9 @@ class ElasticWorkerManager:
             if all(code is not None for _, code in polled):
                 # Whole fleet exited cleanly (or job already done): finished.
                 logger.info("All workers exited; job done")
+                obs.journal().record(
+                    "job_complete", restarts_used=self.restarts_used
+                )
                 self._done_event.set()
                 return
 
@@ -286,6 +323,12 @@ class ElasticWorkerManager:
                     "Worker %d heartbeat stale > %.0fs; killing it",
                     h.worker_id,
                     self._liveness_timeout_s,
+                )
+                self._m_hung_kills.inc()
+                obs.journal().record(
+                    "hung_worker_kill",
+                    worker_id=h.worker_id,
+                    silent_s=self._liveness_timeout_s,
                 )
                 self._substrate_kill(h, 9)
 
@@ -314,6 +357,12 @@ class ElasticWorkerManager:
                 return True
             self._handles = []
             self._num_workers = new_size
+        # Counted only once the regrow is actually committed (a stop()
+        # racing the grant above must not journal a phantom rescale).
+        self._m_relaunches.inc(new_size, reason="scale_up")
+        obs.journal().record(
+            "scale_up", old_size=current, new_size=new_size
+        )
         self._recover_world_tasks(handles)
         self._substrate_terminate(handles)
         self._launch_world(new_size)
@@ -327,11 +376,20 @@ class ElasticWorkerManager:
                 self._describe(h),
                 code,
             )
+            self._m_relaunches.inc(reason=_exit_reason(code))
         with self._lock:
             self._handles = []
             self._restarts_used += 1
             budget_left = self._restarts_used <= self._max_restarts
             old_size = len(handles)
+        obs.journal().record(
+            "worker_churn",
+            workers=[h.worker_id for h, _ in crashed],
+            exit_codes=[code for _, code in crashed],
+            old_size=old_size,
+            restarts_used=self._restarts_used,
+            budget_left=budget_left,
+        )
         self._recover_world_tasks(handles)
         self._substrate_terminate(handles)  # survivors die with the world
         new_size = old_size if budget_left else old_size - 1
@@ -343,6 +401,7 @@ class ElasticWorkerManager:
                 )
                 self._stopped = True
             logger.error("Job failed: %s", reason)
+            obs.journal().record("job_failed", reason=reason)
             self._done_event.set()
             return
         logger.info(

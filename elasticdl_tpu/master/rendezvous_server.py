@@ -28,6 +28,7 @@ import socket
 import time
 from typing import Dict, List, Optional, Tuple
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -62,6 +63,27 @@ class ElasticRendezvous:
         self._coordinator_addr = ""  # guarded-by: _lock
         self._last_heartbeat: Dict[int, Optional[float]] = {}  # guarded-by: _lock
         self._world_declared_at = time.time()  # guarded-by: _lock
+        # Members of the current world that have polled a rank; once the
+        # set covers the world, the formation-duration histogram observes
+        # declaration -> everyone-knows-their-rank once per rendezvous.
+        # Monotonic twin of _world_declared_at: durations must not jump
+        # with NTP steps (_world_declared_at stays wall-clock for the
+        # heartbeat staleness grace).
+        self._world_declared_monotonic = time.monotonic()  # guarded-by: _lock
+        self._ranks_polled: set = set()  # guarded-by: _lock
+        self._formation_observed = True  # guarded-by: _lock
+        self._m_epochs = obs.counter(
+            "elasticdl_rendezvous_epochs_total",
+            "World declarations (rendezvous id bumps)",
+        )
+        self._m_world_size = obs.gauge(
+            "elasticdl_world_size",
+            "Declared world size of the current rendezvous",
+        )
+        self._m_formation = obs.histogram(
+            "elasticdl_rendezvous_formation_duration_seconds",
+            "World declaration -> every member has polled its rank",
+        )
 
     # ------------------------------------------------------------------
     # Master/pod-manager side
@@ -90,15 +112,35 @@ class ElasticRendezvous:
             # startup grace, since world formation (spawn + imports +
             # distributed init barrier) happens before heartbeats begin.
             self._world_declared_at = time.time()
+            self._world_declared_monotonic = time.monotonic()
             self._last_heartbeat = {wid: None for wid, _ in workers}
+            self._ranks_polled = set()
+            self._formation_observed = not workers
+            rendezvous_id = self._rendezvous_id
+            worker_ids = [wid for wid, _ in workers]
+            coordinator = self._coordinator_addr
+            # Gauge + journal INSIDE the lock: concurrent declarations
+            # (scale() racing the monitor's churn path) must publish in
+            # rendezvous-id order, or the gauge can stick at a stale
+            # world size and the journal timeline inverts — declarations
+            # are rare, so the extra hold is noise.
+            self._m_epochs.inc()
+            self._m_world_size.set(len(worker_ids))
+            obs.journal().record(
+                "rendezvous",
+                rendezvous_id=rendezvous_id,
+                world_size=len(worker_ids),
+                workers=worker_ids,
+                coordinator=coordinator,
+            )
             logger.info(
                 "Rendezvous %d: world_size=%d coordinator=%s workers=%s",
-                self._rendezvous_id,
+                rendezvous_id,
                 len(workers),
-                self._coordinator_addr,
-                [wid for wid, _ in workers],
+                coordinator,
+                worker_ids,
             )
-            return self._rendezvous_id
+        return rendezvous_id
 
     @property
     def rendezvous_id(self) -> int:
@@ -173,6 +215,13 @@ class ElasticRendezvous:
             self._resolve_coordinator_locked()
             ids = [wid for wid, _ in self._workers]
             rank = ids.index(worker_id) if worker_id in ids else -1
+            if rank >= 0 and not self._formation_observed:
+                self._ranks_polled.add(worker_id)
+                if self._ranks_polled >= set(ids):
+                    self._formation_observed = True
+                    self._m_formation.observe(
+                        time.monotonic() - self._world_declared_monotonic
+                    )
             return pb.GetCommRankResponse(
                 rank_id=rank,
                 world_size=len(self._workers),

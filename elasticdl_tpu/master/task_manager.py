@@ -22,12 +22,85 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.constants import TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 logger = get_logger("master.task_manager")
+
+
+class _TaskManagerMetrics:
+    """Registry handles for the task lifecycle (the obs tentpole).
+    Get-or-create semantics make re-construction (tests, master resume)
+    idempotent; the per-instance gauges re-bind to the newest manager.
+
+    Gauge callbacks read fields WITHOUT the manager lock: scrapes must
+    never couple the exporter to the control-plane lock, and len()/int
+    reads are atomic enough for a monitoring sample."""
+
+    def __init__(self, manager: "TaskManager"):
+        self.dispatched = obs.counter(
+            "elasticdl_tasks_dispatched_total",
+            "Tasks handed to workers by get()",
+        )
+        self.completed = obs.counter(
+            "elasticdl_tasks_completed_total",
+            "Tasks reported done, by task type",
+            labelnames=("type",),
+        )
+        self.requeues = obs.counter(
+            "elasticdl_task_requeues_total",
+            "Tasks put back on the queue, by cause",
+            labelnames=("reason",),
+        )
+        self.failed_permanently = obs.counter(
+            "elasticdl_tasks_failed_permanently_total",
+            "Tasks dropped after exhausting their retry budget",
+        )
+        self.duration = obs.histogram(
+            "elasticdl_task_duration_seconds",
+            "Dispatch -> done/requeue latency, by task type",
+            labelnames=("type",),
+        )
+        self.worker_batches = obs.counter(
+            "elasticdl_worker_batches_total",
+            "Train/eval batches reported by workers (exec counters)",
+        )
+        self.worker_records = obs.counter(
+            "elasticdl_worker_records_total",
+            "Records reported processed by workers (exec counters)",
+        )
+        # Job-wide throughput: workers already report batch/record exec
+        # counters with every task result (the existing master-client
+        # path); the master turns them into steps/s and examples/s here.
+        self.batch_rate = obs.RateTracker()
+        self.record_rate = obs.RateTracker()
+        obs.gauge(
+            "elasticdl_job_steps_per_second",
+            "Job-wide train steps/s over the trailing minute",
+        ).set_function(self.batch_rate.rate)
+        obs.gauge(
+            "elasticdl_job_examples_per_second",
+            "Job-wide examples/s over the trailing minute",
+        ).set_function(self.record_rate.rate)
+        obs.gauge(
+            "elasticdl_tasks_todo", "Unassigned tasks in the queue"
+        ).set_function(lambda: len(manager._todo))
+        obs.gauge(
+            "elasticdl_tasks_doing", "Tasks in flight on workers"
+        ).set_function(lambda: len(manager._doing))
+        obs.gauge(
+            "elasticdl_training_epoch", "Current training epoch"
+        ).set_function(lambda: manager._epoch)
+
+    @staticmethod
+    def task_type_name(task_type: int) -> str:
+        try:
+            return pb.TaskType.Name(task_type)
+        except ValueError:
+            return "UNKNOWN"
 
 
 @dataclass
@@ -90,6 +163,7 @@ class TaskManager:
         max_task_retries: int = 3,
     ):
         self._lock = make_lock("TaskManager._lock")
+        self._metrics = _TaskManagerMetrics(self)
         self._training_shards = dict(training_shards or {})
         self._evaluation_shards = dict(evaluation_shards or {})
         self._prediction_shards = dict(prediction_shards or {})
@@ -194,9 +268,10 @@ class TaskManager:
         finished_epoch = None
         fired_done = False
         done_callbacks = []
+        journal_events: List[dict] = []
         try:
             with self._lock:
-                self._recover_timed_out_locked()
+                journal_events.extend(self._recover_timed_out_locked())
                 if not self._todo and not self._doing:
                     # Current epoch fully finished: advance or end.
                     if self._epoch + 1 < self._num_epochs and self._training_shards:
@@ -224,9 +299,19 @@ class TaskManager:
                 self._task_id += 1
                 task_id = self._task_id
                 self._doing[task_id] = (worker_id, task, time.time())
+                self._metrics.dispatched.inc()
                 return task.to_proto(task_id)
         finally:
+            # Journal writes happen outside the dispatch lock (file I/O
+            # must never extend control-plane lock holds).
+            for event in journal_events:
+                obs.journal().record(**event)
             if finished_epoch is not None:
+                obs.journal().record(
+                    "train_epoch_done",
+                    epoch=finished_epoch,
+                    next_epoch=finished_epoch + 1,
+                )
                 for callback in self._epoch_done_callbacks:
                     try:
                         callback(finished_epoch)
@@ -243,14 +328,32 @@ class TaskManager:
         """
         fired_done = False
         callbacks_to_run = []
+        journal_events: List[dict] = []
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
                 logger.warning("Report for unknown/expired task %d", task_id)
                 return False
             owner, task, _start = entry
+            type_name = _TaskManagerMetrics.task_type_name(task.type)
+            self._metrics.duration.observe(
+                time.time() - _start, type=type_name
+            )
             eval_done_cbs = []
             if success:
+                self._metrics.completed.inc(type=type_name)
+                batches = (exec_counters or {}).get(
+                    TaskExecCounterKey.BATCH_COUNT, 0
+                )
+                records = (exec_counters or {}).get(
+                    TaskExecCounterKey.RECORD_COUNT, 0
+                )
+                if batches:
+                    self._metrics.worker_batches.inc(batches)
+                    self._metrics.batch_rate.add(batches)
+                if records:
+                    self._metrics.worker_records.inc(records)
+                    self._metrics.record_rate.add(records)
                 if task.type == pb.TRAINING:
                     self._finished_record_count += task.end - task.start
                 if task.type == pb.EVALUATION:
@@ -279,12 +382,33 @@ class TaskManager:
                     task_id, task.shard_name, task.start, task.end,
                     self._max_task_retries,
                 )
+                self._metrics.failed_permanently.inc()
+                journal_events.append(
+                    dict(
+                        event="task_failed_permanently",
+                        task_id=task_id,
+                        shard=task.shard_name,
+                        start=task.start,
+                        end=task.end,
+                        retries=self._max_task_retries,
+                    )
+                )
                 self._permanently_failed.append(task)
             else:
                 task.retry_count += 1
                 logger.info(
                     "Task %d failed; requeueing (retry %d/%d)",
                     task_id, task.retry_count, self._max_task_retries,
+                )
+                self._metrics.requeues.inc(reason="failure")
+                journal_events.append(
+                    dict(
+                        event="task_requeue",
+                        reason="failure",
+                        task_id=task_id,
+                        worker_id=worker_id,
+                        retry=task.retry_count,
+                    )
                 )
                 self._todo.appendleft(task)
                 # Replay accounting: any records this attempt trained
@@ -299,6 +423,8 @@ class TaskManager:
                     self._finalizing = True
                     fired_done = True
                     callbacks_to_run = list(self._tasks_done_callbacks)
+        for event in journal_events:
+            obs.journal().record(**event)
         # Outside the lock: eval-done first (round finalization must see
         # the completed task before any job-level done callbacks run).
         for cb in eval_done_cbs:
@@ -336,26 +462,50 @@ class TaskManager:
                 if task.type == pb.TRAINING:
                     self._recovered_record_count += task.end - task.start
             if recovered:
+                self._metrics.requeues.inc(
+                    len(recovered), reason="worker_churn"
+                )
                 logger.info(
                     "Recovered %d tasks from worker %d", len(recovered), worker_id
                 )
-            return len(recovered)
+        if recovered:
+            obs.journal().record(
+                "task_requeue",
+                reason="worker_churn",
+                worker_id=worker_id,
+                task_ids=recovered,
+            )
+        return len(recovered)
 
-    def _recover_timed_out_locked(self):
+    def _recover_timed_out_locked(self) -> List[dict]:
+        """Returns the journal events for expired tasks; the caller emits
+        them once the dispatch lock is released."""
         if not self._task_timeout_s:
-            return
+            return []
         now = time.time()
         expired = [
             tid
             for tid, (_owner, _task, start) in self._doing.items()
             if now - start > self._task_timeout_s
         ]
+        events = []
         for tid in expired:
             owner, task, _start = self._doing.pop(tid)
             self._todo.appendleft(task)
             if task.type == pb.TRAINING:
                 self._recovered_record_count += task.end - task.start
+            self._metrics.requeues.inc(reason="timeout")
+            events.append(
+                dict(
+                    event="task_requeue",
+                    reason="timeout",
+                    task_id=tid,
+                    worker_id=owner,
+                    timeout_s=self._task_timeout_s,
+                )
+            )
             logger.info("Task %d timed out on worker %d; requeued", tid, owner)
+        return events
 
     # ------------------------------------------------------------------
     # Introspection / lifecycle
@@ -476,6 +626,12 @@ class TaskManager:
         manager._epoch = state["epoch"]
         manager._finished_record_count = state.get("finished_record_count", 0)
         manager._todo.extend(_Task.from_json(t) for t in state["todo"])
+        obs.journal().record(
+            "task_progress_resume",
+            epoch=manager._epoch,
+            todo=len(manager._todo),
+            finished_records=manager._finished_record_count,
+        )
         return manager
 
 
@@ -525,6 +681,7 @@ class TaskProgressPersister:
         import os
         import tempfile
 
+        start = time.monotonic()
         content = self._task_manager.to_checkpoint()
         directory = os.path.dirname(self._path)
         fd, tmp_path = tempfile.mkstemp(
@@ -540,6 +697,12 @@ class TaskProgressPersister:
             except OSError:
                 pass
             raise
+        # Shared declaration with the checkpoint savers — one source of
+        # truth for the family's name/help/labels.
+        from elasticdl_tpu.checkpoint.saver import _ckpt_metrics
+
+        save_hist, _restore, _saves, _quarantines = _ckpt_metrics()
+        save_hist.observe(time.monotonic() - start, kind="task_progress")
 
     def clear(self):
         """Remove the snapshot.  Called after a job COMPLETES successfully:

@@ -1,0 +1,151 @@
+"""Unified observability plane: metrics registry + event journal + spans.
+
+The process-wide singletons live here; instrumented modules use the
+module-level helpers:
+
+    from elasticdl_tpu import obs
+
+    REQUEUES = obs.counter(
+        "elasticdl_task_requeues_total", "Task requeues by cause",
+        labelnames=("reason",),
+    )
+    REQUEUES.inc(reason="timeout")
+
+    with obs.span("task.dispatch", task_id=task_id):
+        ...  # histogram observation + journal record on exit
+
+Conventions (docs/observability.md):
+
+- metric names: `elasticdl_<subsystem>_<what>_<unit?>_total|seconds|...`;
+- labels are bounded enums only (task type, reason, RPC method, kind) —
+  the `metric-label-cardinality` analysis rule rejects task-id/pod/host
+  shaped labels at creation and increment sites;
+- unbounded identifiers ride the JOURNAL as free-form fields (the span
+  API's kwargs go to the journal, never to metric labels).
+
+The exporter (obs/exporter.py, `--metrics_port` on the master) serves the
+default registry and journal; `init_journal` points the journal at its
+JSONL file (one per master, under the TensorBoard log dir).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+from elasticdl_tpu.obs.journal import (
+    DEFAULT_FILENAME,
+    DEFAULT_MAX_BYTES,
+    EventJournal,
+)
+from elasticdl_tpu.obs.metrics import (
+    DURATION_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    RateTracker,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RateTracker",
+    "EventJournal",
+    "DURATION_BUCKETS",
+    "registry",
+    "journal",
+    "counter",
+    "gauge",
+    "histogram",
+    "init_journal",
+    "span",
+]
+
+_registry = MetricsRegistry()
+_journal = EventJournal()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry (what the exporter serves)."""
+    return _registry
+
+
+def journal() -> EventJournal:
+    """The process-wide default event journal."""
+    return _journal
+
+
+def counter(name, help="", labelnames=()) -> Counter:
+    return _registry.counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()) -> Gauge:
+    return _registry.gauge(name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=DURATION_BUCKETS) -> Histogram:
+    return _registry.histogram(name, help, labelnames, buckets=buckets)
+
+
+def init_journal(
+    directory: str,
+    filename: str = DEFAULT_FILENAME,
+    max_bytes: int = DEFAULT_MAX_BYTES,
+) -> str:
+    """Point the default journal at `<directory>/<filename>` (append
+    mode, size-capped rotation).  Returns the journal path.  Never
+    raises: an unusable directory (read-only mount, path component that
+    is a file) degrades to the memory-only journal with a warning —
+    observability must not take the control plane down."""
+    path = os.path.join(directory, filename)
+    try:
+        os.makedirs(directory, exist_ok=True)
+    except OSError:
+        from elasticdl_tpu.obs.journal import logger
+
+        logger.exception(
+            "Journal directory %s unusable; events stay memory-only",
+            directory,
+        )
+        return path
+    _journal.configure(path, max_bytes)  # open failure degrades inside
+    return path
+
+
+def _span_metric_name(name: str) -> str:
+    slug = name.replace(".", "_").replace("-", "_").replace("/", "_")
+    return f"elasticdl_span_{slug}_seconds"
+
+
+@contextlib.contextmanager
+def span(name: str, labels=None, **fields):
+    """Timer emitting BOTH halves of the observability plane: a histogram
+    observation (`elasticdl_span_<name>_seconds`, bounded `labels` only)
+    and a journal record (`fields` may carry unbounded ids — task_id,
+    pod name — which never touch metric labels)."""
+    labels = dict(labels or {})
+    hist = _registry.histogram(
+        _span_metric_name(name),
+        f"Duration of {name} spans",
+        labelnames=tuple(sorted(labels)),
+    )
+    start = time.monotonic()
+    error = None
+    try:
+        yield
+    except BaseException as exc:
+        error = type(exc).__name__
+        raise
+    finally:
+        duration_s = time.monotonic() - start
+        hist.observe(duration_s, **labels)
+        record = {"name": name, "duration_s": round(duration_s, 6)}
+        if error is not None:
+            record["error"] = error
+        record.update(labels)
+        record.update(fields)
+        _journal.record("span", **record)

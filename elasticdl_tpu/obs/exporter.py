@@ -1,0 +1,170 @@
+"""Master-embedded observability HTTP exporter.
+
+Serves the standard production triad on `--metrics_port`:
+
+    /metrics      Prometheus text exposition (0.0.4) of the registry
+    /healthz      liveness JSON ({"status": "ok", "uptime_s": ...})
+    /debug/vars   JSON dump of every metric + the journal's recent tail
+
+Stdlib `http.server` only — no new dependencies.  Requests are handled on
+named daemon threads (thread-hygiene rule: stack dumps from a stuck
+master must attribute exporter threads, and a scrape in flight must never
+hold up process exit).  Scrapes read registry snapshots; they never block
+on control-plane service locks beyond the per-metric copy (see
+obs/metrics.py locking notes).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from socketserver import ThreadingMixIn
+from typing import Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("obs.exporter")
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _ExporterHTTPServer(ThreadingMixIn, HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def process_request(self, request, client_address):
+        # Override ThreadingMixIn: request threads carry name=/daemon=
+        # (thread-hygiene rule — attributable stack dumps, deliberate
+        # shutdown semantics).
+        thread = threading.Thread(
+            target=self.process_request_thread,
+            args=(request, client_address),
+            name="obs-exporter-request",
+            daemon=True,
+        )
+        thread.start()
+
+
+class MetricsExporter:
+    """One HTTP server over a (registry, journal) pair.  `port=0` binds a
+    free port (tests); `start()` returns self so callers can chain."""
+
+    def __init__(
+        self,
+        registry=None,
+        journal=None,
+        port: int = 0,
+        host: str = "",
+        journal_tail: int = 100,
+    ):
+        if registry is None or journal is None:
+            from elasticdl_tpu import obs
+
+            registry = registry or obs.registry()
+            journal = journal or obs.journal()
+        self._registry = registry
+        self._journal = journal
+        self._host = host
+        self._port = port
+        self._journal_tail = journal_tail
+        self._server: Optional[_ExporterHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started_monotonic = 0.0
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> "MetricsExporter":
+        self._started_monotonic = time.monotonic()
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "elasticdl-obs/1"
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                exporter._handle(self)
+
+            def log_message(self, format, *args):
+                pass  # scrape traffic must not spam the master log
+
+        self._server = _ExporterHTTPServer(
+            (self._host, self._port), Handler
+        )
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="obs-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info(
+            "Metrics exporter listening on port %d "
+            "(/metrics, /healthz, /debug/vars)", self._port,
+        )
+        return self
+
+    def stop(self):
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------------
+
+    def _handle(self, request: BaseHTTPRequestHandler):
+        path = request.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = self._registry.render_prometheus().encode("utf-8")
+                content_type = PROMETHEUS_CONTENT_TYPE
+            elif path == "/healthz":
+                body = json.dumps(
+                    {
+                        "status": "ok",
+                        "uptime_s": round(
+                            time.monotonic() - self._started_monotonic, 3
+                        ),
+                    }
+                ).encode("utf-8")
+                content_type = "application/json"
+            elif path == "/debug/vars":
+                body = json.dumps(
+                    {
+                        "metrics": self._registry.to_dict(),
+                        "journal": {
+                            "path": self._journal.path,
+                            "tail": self._journal.tail(self._journal_tail),
+                        },
+                    },
+                    default=str,
+                ).encode("utf-8")
+                content_type = "application/json"
+            else:
+                body = b"not found (try /metrics, /healthz, /debug/vars)\n"
+                request.send_response(404)
+                request.send_header("Content-Type", "text/plain")
+                request.send_header("Content-Length", str(len(body)))
+                request.end_headers()
+                request.wfile.write(body)
+                return
+        except Exception:
+            # A scrape failure is the exporter's bug, never the master's:
+            # answer 500 and keep serving.
+            logger.exception("Exporter request %s failed", path)
+            try:
+                request.send_error(500)
+            except OSError:
+                pass
+            return
+        request.send_response(200)
+        request.send_header("Content-Type", content_type)
+        request.send_header("Content-Length", str(len(body)))
+        request.end_headers()
+        request.wfile.write(body)

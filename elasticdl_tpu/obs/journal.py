@@ -1,0 +1,152 @@
+"""Control-plane event journal: a timestamped, greppable JSONL timeline.
+
+Every elastic event — rescale, rendezvous epoch bump, task requeue,
+quarantined checkpoint — gets one JSON record, so an operator (or a test)
+can reconstruct a job's lifecycle post-hoc without correlating log lines
+across processes.  One file per master, under the TensorBoard log dir
+(next to the scalar events it complements); size-capped with a single
+rotation (`events.jsonl` -> `events.jsonl.1`) so a pathological requeue
+storm can never fill the disk.
+
+Record shape (one per line):
+
+    {"ts": <unix seconds>, "event": "<type>", ...free-form fields}
+
+Unbounded identifiers (task ids, pod names, hosts) belong HERE, not in
+metric labels — the journal is the high-cardinality half of the
+observability plane (docs/observability.md tabulates the event schema).
+
+The journal also keeps an in-memory ring of recent records regardless of
+file configuration: the exporter's /debug/vars serves that tail, and
+unconfigured processes (workers, unit tests) still have an inspectable
+timeline.  Journal writes are best-effort: an unwritable log dir degrades
+to the memory ring with one warning — observability never takes the
+control plane down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import List, Optional
+
+from elasticdl_tpu.analysis.runtime import make_lock
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("obs.journal")
+
+DEFAULT_FILENAME = "events.jsonl"
+DEFAULT_MAX_BYTES = 8 << 20
+ROTATED_SUFFIX = ".1"
+
+
+class EventJournal:
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        tail_events: int = 256,
+    ):
+        self._lock = make_lock("EventJournal._lock")
+        self._path: Optional[str] = None  # guarded-by: _lock
+        self._file = None  # guarded-by: _lock
+        self._size = 0  # guarded-by: _lock
+        self._max_bytes = max_bytes  # guarded-by: _lock
+        self._tail: deque = deque(maxlen=tail_events)  # guarded-by: _lock
+        self._write_errors = 0  # guarded-by: _lock
+        if path:
+            self.configure(path, max_bytes)
+
+    @property
+    def path(self) -> Optional[str]:
+        with self._lock:
+            return self._path
+
+    def configure(
+        self, path: Optional[str], max_bytes: Optional[int] = None
+    ) -> Optional[str]:
+        """(Re)point the journal at `path` (append mode — a replacement
+        master continues its predecessor's timeline).  `None` closes the
+        file and reverts to memory-only."""
+        with self._lock:
+            self._close_locked()
+            self._path = path
+            if max_bytes is not None:
+                self._max_bytes = max_bytes
+            if path is None:
+                return None
+            try:
+                self._file = open(path, "a", encoding="utf-8")
+                self._size = os.path.getsize(path)
+            except OSError:
+                logger.exception(
+                    "Event journal %s unwritable; events stay memory-only",
+                    path,
+                )
+                self._file = None
+            return path
+
+    def close(self):
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self):
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        self._size = 0
+
+    def record(self, event: str, **fields) -> dict:
+        """Append one journal record; returns it (tests assert on the
+        return value without re-reading the file)."""
+        rec = {"ts": round(time.time(), 6), "event": event}
+        rec.update(fields)
+        with self._lock:
+            self._tail.append(rec)
+            if self._file is None:
+                # Memory-only (worker processes, unconfigured tests):
+                # skip serialization entirely — the tail stores the dict.
+                return rec
+            try:
+                line = (
+                    json.dumps(rec, default=str, separators=(",", ":"))
+                    + "\n"
+                )
+                # Byte accounting, not characters: _size seeds from
+                # getsize() (bytes) and the cap guards disk, so
+                # multi-byte text must count at its encoded width.
+                nbytes = len(line.encode("utf-8"))
+                if self._size + nbytes > self._max_bytes:
+                    self._rotate_locked()
+                self._file.write(line)
+                self._file.flush()
+                self._size += nbytes
+            except OSError:
+                self._write_errors += 1
+                if self._write_errors == 1:
+                    logger.exception(
+                        "Event journal write to %s failed; further events "
+                        "stay memory-only until reconfigured", self._path,
+                    )
+                self._close_locked()
+        return rec
+
+    def _rotate_locked(self):
+        """Size cap reached: the current file becomes `.1` (replacing any
+        previous rotation) and a fresh file opens — at most 2x max_bytes
+        on disk, and the newest events are always in the primary file."""
+        self._file.close()
+        self._file = None
+        os.replace(self._path, self._path + ROTATED_SUFFIX)
+        self._file = open(self._path, "a", encoding="utf-8")
+        self._size = 0
+
+    def tail(self, n: int = 50) -> List[dict]:
+        with self._lock:
+            events = list(self._tail)
+        return events[-n:]

@@ -25,6 +25,7 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
@@ -109,12 +110,21 @@ def join_world(
             info.world_size,
             info.coordinator_addr,
         )
-        jax.distributed.initialize(
-            coordinator_address=info.coordinator_addr,
-            num_processes=info.world_size,
-            process_id=info.rank,
-            initialization_timeout=initialization_timeout_s,
-        )
+        # Span: the worker-side half of world-formation cost (the
+        # distributed-init barrier) — the master-side half is
+        # elasticdl_rendezvous_formation_duration_seconds.
+        with obs.span(
+            "worker.join_world",
+            rendezvous_id=info.rendezvous_id,
+            rank=info.rank,
+            world_size=info.world_size,
+        ):
+            jax.distributed.initialize(
+                coordinator_address=info.coordinator_addr,
+                num_processes=info.world_size,
+                process_id=info.rank,
+                initialization_timeout=initialization_timeout_s,
+            )
     return info
 
 

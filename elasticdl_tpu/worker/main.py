@@ -17,8 +17,24 @@ from elasticdl_tpu.worker.worker import Worker
 logger = get_logger("worker.main")
 
 
+def _sigterm_to_systemexit(signum, frame):
+    """Convert the pod manager's graceful terminate() (SIGTERM) into a
+    normal interpreter exit so `finally` blocks and atexit hooks run —
+    most importantly the StepProfiler flush: a preempted worker
+    mid-profile-window ships a partial trace instead of losing it.
+    The manager escalates to SIGKILL after its grace period, so a hung
+    shutdown still dies."""
+    raise SystemExit(128 + signum)
+
+
 def main(argv=None):
     import os
+    import signal
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_to_systemexit)
+    except ValueError:
+        pass  # not the main thread (in-process test harnesses)
 
     # The host environment may force-select its accelerator platform at
     # interpreter start (sitecustomize), overriding JAX_PLATFORMS; honor an

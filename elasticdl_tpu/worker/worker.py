@@ -14,6 +14,7 @@ from typing import Optional
 
 import numpy as np
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.constants import Mode, TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
@@ -126,15 +127,24 @@ class Worker:
     # ------------------------------------------------------------------
 
     def _process_task(self, task) -> dict:
-        if task.type == pb.TRAINING:
-            return self._process_train_task(task)
-        if task.type == pb.EVALUATION:
-            return self._process_eval_task(task)
-        if task.type == pb.PREDICTION:
-            return self._process_predict_task(task)
-        if task.type == pb.TRAIN_END_CALLBACK:
-            return self._process_train_end(task)
-        raise ValueError(f"Unknown task type {task.type}")
+        try:
+            type_name = pb.TaskType.Name(task.type)
+        except ValueError:
+            type_name = "UNKNOWN"
+        # Span: per-task worker-side latency histogram (bounded `type`
+        # label) + a journal record carrying the unbounded task id.
+        with obs.span(
+            "worker.task", labels={"type": type_name}, task_id=task.task_id
+        ):
+            if task.type == pb.TRAINING:
+                return self._process_train_task(task)
+            if task.type == pb.EVALUATION:
+                return self._process_eval_task(task)
+            if task.type == pb.PREDICTION:
+                return self._process_predict_task(task)
+            if task.type == pb.TRAIN_END_CALLBACK:
+                return self._process_train_end(task)
+            raise ValueError(f"Unknown task type {task.type}")
 
     def _get_batches(self, task, mode: str):
         # The user's dataset_fn parses/shuffles records; the worker applies

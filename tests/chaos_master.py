@@ -35,6 +35,12 @@ def main(argv):
     ckpt_dir, port, shard_name = argv[0], int(argv[1]), argv[2]
     n_records, records_per_task, num_epochs = (int(v) for v in argv[3:6])
     faults.install_from_env()
+    # Journal before anything else: both master generations append to the
+    # same timeline, so the SIGKILL + resume cycle is reconstructable
+    # post-hoc (the chaos test asserts on these records).
+    from elasticdl_tpu import obs
+
+    obs.init_journal(ckpt_dir)
 
     resumed = False
     resumed_finished = 0
@@ -52,6 +58,9 @@ def main(argv):
             num_epochs=num_epochs,
         )
 
+    obs.journal().record(
+        "master_start", resumed=resumed, finished_records=resumed_finished
+    )
     servicer = MasterServicer(task_manager=task_manager)
     # The replacement master binds the SAME port its predecessor was
     # SIGKILLed on; brief bind failures (straggling kernel state) retry.

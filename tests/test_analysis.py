@@ -320,6 +320,86 @@ def test_lock_discipline_standalone_block_for_inherited_fields():
 
 
 # ---------------------------------------------------------------------------
+# metric-label-cardinality
+# ---------------------------------------------------------------------------
+
+
+def test_metric_cardinality_flags_unbounded_labelnames():
+    found = violations(
+        """
+        def f(obs):
+            obs.counter("t_total", "h", labelnames=("task_id", "type"))
+            obs.histogram("d_seconds", "h", labelnames=["pod_name"])
+        """,
+        "metric-label-cardinality",
+    )
+    assert len(found) == 2
+    assert "task_id" in found[0].message and "journal" in found[0].message
+
+
+def test_metric_cardinality_flags_unbounded_label_kwargs():
+    found = violations(
+        """
+        def f(metric, task, pod):
+            metric.inc(task_id=task.id)
+            metric.labels(worker_id=3).observe(0.1)
+            metric.set(1.0, host=pod.ip)
+        """,
+        "metric-label-cardinality",
+    )
+    assert len(found) == 3
+
+
+def test_metric_cardinality_flags_dynamic_metric_names():
+    found = violations(
+        """
+        def f(obs, task):
+            obs.counter(f"task_{task.id}_total", "h")
+            obs.gauge("prefix_" + task.name, "h")
+        """,
+        "metric-label-cardinality",
+    )
+    assert len(found) == 2
+    assert "dynamic metric name" in found[0].message
+
+
+def test_metric_cardinality_ignores_non_metric_lookalikes():
+    """collections.Counter arithmetic and unrelated .counter()/.histogram()
+    methods must not trip the rule — only registry-shaped receivers do."""
+    found = violations(
+        """
+        import collections
+
+        def f(a, b, dataframe, name):
+            total = collections.Counter(a + b)
+            dataframe.histogram(f"col_{name}")
+            stats = a.counter("x" + name)
+            return total, stats
+        """,
+        "metric-label-cardinality",
+    )
+    assert found == []
+
+
+def test_metric_cardinality_accepts_bounded_labels_and_journal_fields():
+    found = violations(
+        """
+        def f(obs, journal, task):
+            c = obs.counter(
+                "elasticdl_task_requeues_total", "h",
+                labelnames=("reason", "type"),
+            )
+            c.inc(reason="timeout", type="TRAINING")
+            obs.histogram("d_seconds", "h", labelnames=("kind",))
+            # Unbounded identifiers ride the JOURNAL, which is fine.
+            journal.record("task_requeue", task_id=task.id, pod="w-3")
+        """,
+        "metric-label-cardinality",
+    )
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 # ---------------------------------------------------------------------------
 
@@ -392,12 +472,17 @@ _SEEDED_VIOLATIONS = {
         "    def bad(self):\n"
         "        self._x = 1\n"
     ),
+    "metric-label-cardinality": (
+        "def f(obs, task):\n"
+        "    c = obs.counter('t_total', 'h', labelnames=('task_id',))\n"
+        "    c.inc(task_id=task.id)\n"
+    ),
 }
 
 
 def test_cli_exits_nonzero_on_each_seeded_rule_violation(tmp_path, capsys):
-    """Acceptance: `make check-invariants` fails on a violation of EACH of
-    the five rules."""
+    """Acceptance: `make check-invariants` fails on a violation of EACH
+    registered rule."""
     assert set(_SEEDED_VIOLATIONS) == set(RULE_NAMES)
     for rule, text in _SEEDED_VIOLATIONS.items():
         bad = tmp_path / f"{rule.replace('-', '_')}.py"

@@ -231,6 +231,15 @@ def test_master_sigkill_midjob_workers_ride_through(tmp_path):
         # Workers rode through the outage on the retry plane.
         assert sum(c.retry_stats.retries for c in clients) > 0
 
+        # The journal reconstructs the outage post-hoc: both master
+        # generations appended to one timeline (events.jsonl survives the
+        # SIGKILL), the resume and the training-epoch bump are on record.
+        with open(ckpt_dir / "events.jsonl") as f:
+            events = [json.loads(line) for line in f if line.strip()]
+        assert sum(e["event"] == "master_start" for e in events) == 2
+        assert any(e["event"] == "task_progress_resume" for e in events)
+        assert any(e["event"] == "train_epoch_done" for e in events)
+
         # No lost records: every record of BOTH epochs completed at least
         # once across the two master generations (at-least-once).
         for epoch in range(epochs):
@@ -251,6 +260,58 @@ def test_master_sigkill_midjob_workers_ride_through(tmp_path):
             client.close()
         if os.path.exists(master_log):
             sys.stderr.write(open(master_log).read()[-4000:])
+
+
+# ---------------------------------------------------------------------------
+# Event journal: a rescale is reconstructable from the JSONL timeline.
+# ---------------------------------------------------------------------------
+
+
+def test_journal_reconstructs_rescale(tmp_path):
+    """Acceptance: a worker-death rescale leaves journal records that
+    reconstruct it — the rendezvous epoch bump AND the churn requeues, in
+    order — without consulting any log file."""
+    from elasticdl_tpu import obs
+    from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
+    from elasticdl_tpu.master.task_manager import TaskManager
+
+    journal_path = obs.init_journal(str(tmp_path))
+    try:
+        manager = TaskManager(
+            training_shards={"shard": 256}, records_per_task=64
+        )
+        rendezvous = ElasticRendezvous(
+            coordinator_port_fn=lambda host: 12345
+        )
+        rendezvous.set_worker_hosts([(0, "127.0.0.1"), (1, "127.0.0.1")])
+        task0 = manager.get(0)
+        task1 = manager.get(1)
+        assert task0.task_id >= 0 and task1.task_id >= 0
+        # Worker 1 dies: its in-flight task requeues and the world
+        # re-forms one smaller under a fresh rendezvous id.
+        manager.recover_tasks(1)
+        rendezvous.set_worker_hosts([(0, "127.0.0.1")])
+
+        with open(journal_path) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+        declarations = [
+            (i, e) for i, e in enumerate(events) if e["event"] == "rendezvous"
+        ]
+        assert [e["rendezvous_id"] for _, e in declarations] == [1, 2]
+        assert [e["world_size"] for _, e in declarations] == [2, 1]
+        requeues = [
+            (i, e) for i, e in enumerate(events) if e["event"] == "task_requeue"
+        ]
+        assert len(requeues) == 1
+        index, requeue = requeues[0]
+        assert requeue["reason"] == "worker_churn"
+        assert requeue["worker_id"] == 1
+        assert requeue["task_ids"] == [task1.task_id]
+        # Order on the timeline: world declared, worker died (requeue),
+        # shrunk world declared.
+        assert declarations[0][0] < index < declarations[1][0]
+    finally:
+        obs.journal().configure(None)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,567 @@
+"""Observability plane tests (elasticdl_tpu/obs).
+
+Covers the tentpole's acceptance surface:
+
+- registry semantics (counter/gauge/histogram, labels, get-or-create)
+  and Prometheus text exposition;
+- registry concurrency under ``ELASTICDL_LOCKCHECK=1`` (hammered from
+  threads, exact totals, clean lock-order report);
+- exporter endpoint round-trip (/metrics + /healthz + /debug/vars over
+  real HTTP, parsed, instrumented values asserted);
+- journal rotation at the size cap;
+- the master-side end-to-end: an in-process master (task manager +
+  rendezvous + gRPC servicer + retrying client + checkpoint savers +
+  crashing local worker fleet) scraped over /metrics contains the task
+  latency histograms, rendezvous epoch/world-size, pod relaunch
+  counters, RPC retry counters, and checkpoint duration metrics the
+  ISSUE acceptance criteria name;
+- the RetryStats periodic-summary satellite and the StepProfiler
+  shutdown-flush satellite.
+"""
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.obs.exporter import MetricsExporter
+from elasticdl_tpu.obs.journal import EventJournal
+from elasticdl_tpu.obs.metrics import MetricsRegistry, RateTracker
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_labels_values_and_monotonicity():
+    registry = MetricsRegistry()
+    counter = registry.counter("c_total", "help", labelnames=("kind",))
+    counter.inc(kind="a")
+    counter.inc(2.5, kind="a")
+    counter.inc(kind="b")
+    assert counter.value(kind="a") == 3.5
+    assert counter.value(kind="b") == 1
+    with pytest.raises(ValueError):
+        counter.inc(-1, kind="a")
+    with pytest.raises(ValueError):
+        counter.inc(kind="a", extra="nope")
+    with pytest.raises(ValueError):
+        counter.inc()  # missing the declared label
+
+
+def test_gauge_set_inc_and_function_callbacks():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("g", "help")
+    gauge.set(5)
+    gauge.inc(2)
+    gauge.dec(3)
+    assert gauge.value() == 4
+    fn_gauge = registry.gauge("g_fn", "help")
+    box = {"v": 7}
+    fn_gauge.set_function(lambda: box["v"])
+    assert fn_gauge.value() == 7
+    box["v"] = 9
+    assert fn_gauge.value() == 9
+    # A dying callback never breaks the scrape; its sample is dropped.
+    fn_gauge.set_function(lambda: 1 / 0)
+    lines = registry.render_prometheus().splitlines()
+    assert not any(line.startswith("g_fn ") for line in lines)
+    assert any(line.startswith("g ") for line in lines)
+
+
+def test_histogram_buckets_sum_count_and_exposition():
+    registry = MetricsRegistry()
+    hist = registry.histogram(
+        "h_seconds", "help", labelnames=("op",), buckets=(0.1, 1.0, 10.0)
+    )
+    for value in (0.05, 0.5, 5.0, 50.0):
+        hist.observe(value, op="x")
+    assert hist.count(op="x") == 4
+    assert hist.sum(op="x") == pytest.approx(55.55)
+    text = registry.render_prometheus()
+    assert '# TYPE h_seconds histogram' in text
+    assert 'h_seconds_bucket{le="0.1",op="x"} 1' in text
+    assert 'h_seconds_bucket{le="1",op="x"} 2' in text
+    assert 'h_seconds_bucket{le="10",op="x"} 3' in text
+    assert 'h_seconds_bucket{le="+Inf",op="x"} 4' in text
+    assert 'h_seconds_count{op="x"} 4' in text
+
+
+def test_registry_get_or_create_and_type_conflicts():
+    registry = MetricsRegistry()
+    first = registry.counter("same_total", "h", labelnames=("a",))
+    assert registry.counter("same_total", "h", labelnames=("a",)) is first
+    with pytest.raises(ValueError):
+        registry.gauge("same_total", "h")  # wrong type
+    with pytest.raises(ValueError):
+        registry.counter("same_total", "h", labelnames=("b",))  # wrong labels
+
+
+def test_exposition_escapes_label_values():
+    registry = MetricsRegistry()
+    counter = registry.counter("esc_total", "h", labelnames=("v",))
+    counter.inc(v='say "hi"\nback\\slash')
+    line = [
+        ln for ln in registry.render_prometheus().splitlines()
+        if ln.startswith("esc_total{")
+    ][0]
+    assert '\\"hi\\"' in line and "\\n" in line and "\\\\slash" in line
+
+
+def test_unlabeled_counter_exports_at_zero():
+    registry = MetricsRegistry()
+    registry.counter("zero_total", "present before the first event")
+    assert "\nzero_total 0" in registry.render_prometheus()
+
+
+def test_rate_tracker_window():
+    tracker = RateTracker(window_s=10.0)
+    assert tracker.rate(now=0.0) == 0.0
+    tracker.add(50, now=1.0)
+    tracker.add(50, now=5.0)
+    assert tracker.rate(now=5.0) == pytest.approx(10.0)
+    # Events age out of the window.
+    assert tracker.rate(now=100.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Concurrency under the runtime lock checker
+# ---------------------------------------------------------------------------
+
+
+def test_registry_concurrency_under_lockcheck(monkeypatch):
+    """Hammer counters/gauges/histograms (and concurrent scrapes) from
+    threads with ELASTICDL_LOCKCHECK=1: exact totals, no lost updates, and
+    a clean lock-order report."""
+    monkeypatch.setenv("ELASTICDL_LOCKCHECK", "1")
+    from elasticdl_tpu.analysis import runtime
+
+    runtime.reset()
+    try:
+        registry = MetricsRegistry()  # locks created under lockcheck
+        counter = registry.counter("hammer_total", "h", labelnames=("t",))
+        hist = registry.histogram("hammer_seconds", "h")
+        gauge = registry.gauge("hammer_gauge", "h")
+        gauge.set_function(lambda: counter.value(t="0"))
+        iterations, n_threads = 400, 8
+
+        def hammer(thread_index):
+            for k in range(iterations):
+                counter.inc(t=str(thread_index % 2))
+                hist.observe(0.001 * (k % 7))
+                if k % 100 == 0:
+                    registry.render_prometheus()  # concurrent scrapes
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,),
+                             name=f"obs-hammer-{i}", daemon=True)
+            for i in range(n_threads)
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=60)
+            assert not thread.is_alive()
+        assert (
+            counter.value(t="0") + counter.value(t="1")
+            == iterations * n_threads
+        )
+        assert hist.count() == iterations * n_threads
+        report = runtime.report()
+        assert report["acquisitions"] > 0, "lockcheck never engaged"
+        runtime.assert_clean()
+    finally:
+        runtime.reset()
+
+
+# ---------------------------------------------------------------------------
+# Journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_records_and_tail(tmp_path):
+    journal = EventJournal(str(tmp_path / "j.jsonl"))
+    journal.record("alpha", x=1)
+    journal.record("beta", pod="w-3")
+    with open(tmp_path / "j.jsonl") as f:
+        events = [json.loads(line) for line in f]
+    assert [e["event"] for e in events] == ["alpha", "beta"]
+    assert all("ts" in e for e in events)
+    assert [e["event"] for e in journal.tail(1)] == ["beta"]
+    journal.close()
+
+
+def test_journal_rotation_at_size_cap(tmp_path):
+    path = tmp_path / "events.jsonl"
+    journal = EventJournal(str(path), max_bytes=2000)
+    for i in range(100):
+        journal.record("evt", i=i, pad="x" * 40)
+    journal.close()
+    rotated = tmp_path / "events.jsonl.1"
+    assert rotated.exists(), "size cap never rotated"
+    assert os.path.getsize(path) <= 2000
+    assert os.path.getsize(rotated) <= 2000
+    # Both files hold valid JSONL and the newest events are in the
+    # primary file.
+    primary = [json.loads(line) for line in open(path)]
+    old = [json.loads(line) for line in open(rotated)]
+    assert primary and old
+    assert primary[-1]["i"] == 99
+    assert old[-1]["i"] < primary[0]["i"]
+    # The in-memory tail survives rotation untruncated.
+    assert journal.tail(5)[-1]["i"] == 99
+
+
+def test_journal_memory_only_without_configuration():
+    journal = EventJournal()
+    journal.record("only_in_memory")
+    assert journal.path is None
+    assert journal.tail(1)[0]["event"] == "only_in_memory"
+
+
+def test_span_emits_histogram_and_journal_record():
+    hist_before = obs.histogram(
+        "elasticdl_span_obs_test_span_seconds", "Duration of obs.test.span spans"
+    ).count()
+    with obs.span("obs.test.span", task_id=42):
+        pass
+    hist = obs.registry().get("elasticdl_span_obs_test_span_seconds")
+    assert hist.count() == hist_before + 1
+    spans = [e for e in obs.journal().tail(20) if e["event"] == "span"]
+    assert spans and spans[-1]["name"] == "obs.test.span"
+    assert spans[-1]["task_id"] == 42
+    assert spans[-1]["duration_s"] >= 0
+
+
+def test_span_records_error_type():
+    with pytest.raises(RuntimeError):
+        with obs.span("obs.test.failing"):
+            raise RuntimeError("boom")
+    spans = [e for e in obs.journal().tail(20) if e["event"] == "span"]
+    assert spans[-1]["error"] == "RuntimeError"
+
+
+# ---------------------------------------------------------------------------
+# Exporter round-trip
+# ---------------------------------------------------------------------------
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as response:
+        return response.status, response.read().decode()
+
+
+def test_exporter_roundtrip_metrics_healthz_debug_vars(tmp_path):
+    registry = MetricsRegistry()
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    registry.counter("demo_total", "help").inc(3)
+    registry.histogram(
+        "demo_seconds", "help", labelnames=("op",)
+    ).observe(0.12, op="save")
+    journal.record("hello", worker_id=1)
+    exporter = MetricsExporter(
+        registry=registry, journal=journal, port=0
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        status, text = _get(base + "/metrics")
+        assert status == 200
+        assert "\ndemo_total 3" in text
+        assert 'demo_seconds_bucket{le="+Inf",op="save"} 1' in text
+        status, body = _get(base + "/healthz")
+        health = json.loads(body)
+        assert health["status"] == "ok" and health["uptime_s"] >= 0
+        status, body = _get(base + "/debug/vars")
+        debug = json.loads(body)
+        assert debug["metrics"]["demo_total"]["values"][""] == 3
+        assert debug["metrics"]["demo_seconds"]["type"] == "histogram"
+        assert debug["journal"]["path"].endswith("events.jsonl")
+        assert debug["journal"]["tail"][-1]["event"] == "hello"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(base + "/nope")
+        assert err.value.code == 404
+    finally:
+        exporter.stop()
+        journal.close()
+
+
+# ---------------------------------------------------------------------------
+# RetryStats satellites: registry fold-in + rate-limited summary
+# ---------------------------------------------------------------------------
+
+
+def _capture_logs(logger_name, records):
+    handler = logging.Handler()
+    handler.emit = records.append
+    logging.getLogger(logger_name).addHandler(handler)
+    return handler
+
+
+def test_retry_stats_feed_the_registry():
+    from elasticdl_tpu.common.grpc_utils import RetryStats
+
+    retries = obs.registry().get("elasticdl_rpc_retries_total")
+    give_ups = obs.registry().get("elasticdl_rpc_give_ups_total")
+    before_r = retries.value(method="get_task")
+    before_g = give_ups.value(method="get_task")
+    stats = RetryStats()
+    stats.record_call()
+    for _ in range(3):
+        stats.record_retry("get_task")
+    stats.record_give_up("get_task", "UNAVAILABLE")
+    assert retries.value(method="get_task") == before_r + 3
+    assert give_ups.value(method="get_task") == before_g + 1
+    assert stats.retries == 3 and stats.give_ups == 1  # per-client view
+
+
+def test_retry_summary_is_rate_limited():
+    from elasticdl_tpu.common.grpc_utils import RetryStats
+
+    stats = RetryStats()
+    records = []
+    handler = _capture_logs("elasticdl_tpu.common.grpc_utils", records)
+    try:
+        stats.record_retry("get_task")
+        stats.maybe_log_summary(now=0.0)  # opens the window, no line
+        stats.record_retry("get_task")
+        stats.record_retry("report_version")
+        stats.maybe_log_summary(now=100.0)  # inside the window: silent
+        assert records == []
+        stats.maybe_log_summary(now=301.0)  # window elapsed: one line
+        summaries = [
+            r.getMessage() for r in records
+            if "RPC retry summary" in r.getMessage()
+        ]
+        assert len(summaries) == 1
+        assert "2 retries" in summaries[0]
+        assert "get_task=1" in summaries[0]
+        assert "report_version=1" in summaries[0]
+        # Quiet window: no traffic, no line.
+        stats.maybe_log_summary(now=1000.0)
+        summaries = [
+            r.getMessage() for r in records
+            if "RPC retry summary" in r.getMessage()
+        ]
+        assert len(summaries) == 1
+    finally:
+        logging.getLogger("elasticdl_tpu.common.grpc_utils").removeHandler(
+            handler
+        )
+
+
+# ---------------------------------------------------------------------------
+# StepProfiler satellite: shutdown flush is registered
+# ---------------------------------------------------------------------------
+
+
+def test_step_profiler_registers_atexit_flush(monkeypatch):
+    import atexit
+
+    from elasticdl_tpu.common import profiler
+
+    registered = []
+    monkeypatch.setattr(
+        atexit, "register", lambda fn, *a, **k: registered.append(fn) or fn
+    )
+    inactive = profiler.StepProfiler("", "", worker_id=0)
+    assert registered == []  # unconfigured profiler: no hook
+    active = profiler.StepProfiler("/tmp/logs", "5,10", worker_id=0)
+    assert registered == [active.stop]
+    assert inactive is not active
+
+
+def test_worker_main_converts_sigterm_to_systemexit():
+    from elasticdl_tpu.worker.main import _sigterm_to_systemexit
+
+    with pytest.raises(SystemExit) as excinfo:
+        _sigterm_to_systemexit(15, None)
+    assert excinfo.value.code == 143
+
+
+# ---------------------------------------------------------------------------
+# Master end-to-end: one scrape shows the whole elastic control plane
+# ---------------------------------------------------------------------------
+
+
+def test_master_metrics_exporter_end_to_end(tmp_path):
+    """The ISSUE acceptance scrape: a master serving real traffic exports
+    task-latency histograms, rendezvous epoch/world-size, pod relaunch
+    counters, RPC retry counters, and checkpoint duration metrics."""
+    from elasticdl_tpu.checkpoint.saver import CheckpointSaver
+    from elasticdl_tpu.common import faults
+    from elasticdl_tpu.common.constants import TaskExecCounterKey
+    from elasticdl_tpu.common.grpc_utils import RetryPolicy
+    from elasticdl_tpu.master.pod_manager import LocalProcessManager
+    from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
+    from elasticdl_tpu.master.servicer import (
+        MasterServicer,
+        start_master_server,
+    )
+    from elasticdl_tpu.master.task_manager import (
+        TaskManager,
+        TaskProgressPersister,
+    )
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.worker.master_client import MasterClient
+
+    # The default registry accumulates across the whole pytest session
+    # (instrumented services run in many tests), so correctness asserts
+    # are DELTAS against these baselines; the scrape asserts presence.
+    task_manager = TaskManager(
+        training_shards={"shard": 128}, records_per_task=64
+    )
+    m_task_duration = obs.histogram(
+        "elasticdl_task_duration_seconds", labelnames=("type",)
+    )
+    m_formation = obs.histogram(
+        "elasticdl_rendezvous_formation_duration_seconds"
+    )
+    m_retries = obs.counter(
+        "elasticdl_rpc_retries_total", labelnames=("method",)
+    )
+    m_relaunches = obs.counter(
+        "elasticdl_worker_relaunches_total", labelnames=("reason",)
+    )
+    m_saves = obs.histogram(
+        "elasticdl_checkpoint_save_duration_seconds", labelnames=("kind",)
+    )
+    m_restores = obs.histogram(
+        "elasticdl_checkpoint_restore_duration_seconds", labelnames=("kind",)
+    )
+    base_train_done = m_task_duration.count(type="TRAINING")
+    base_formations = m_formation.count()
+    base_retries = m_retries.value(method="get_task")
+    base_crashes = m_relaunches.value(reason="crash")
+    base_full_saves = m_saves.count(kind="full")
+    base_progress_saves = m_saves.count(kind="task_progress")
+    base_restores = m_restores.count(kind="full")
+    rendezvous = ElasticRendezvous(coordinator_port_fn=lambda host: 23456)
+    rendezvous.set_worker_hosts([(0, "127.0.0.1")])
+    servicer = MasterServicer(
+        task_manager=task_manager, rendezvous_server=rendezvous
+    )
+    server, port = start_master_server(servicer, port=0)
+    client = MasterClient(
+        f"localhost:{port}",
+        worker_id=0,
+        retry_policy=RetryPolicy(
+            timeout_s=5.0, max_attempts=5, base_backoff_s=0.01,
+            max_backoff_s=0.05, jitter=0.0, total_budget_s=30.0,
+            wait_for_ready=True,
+        ),
+    )
+    exporter = MetricsExporter(port=0).start()  # the default registry
+    try:
+        # RPC retry plane: the first get_task attempt fails transiently.
+        faults.install("rpc.get_task:error=UNAVAILABLE@1")
+        assert client.get_comm_rank().rank_id == 0  # rendezvous formation
+        while True:
+            task = client.get_task()
+            if task.task_id == -1 and task.type != pb.WAIT:
+                break
+            if task.type == pb.WAIT:
+                time.sleep(0.05)
+                continue
+            client.report_task_result(
+                task.task_id,
+                "",
+                exec_counters={
+                    TaskExecCounterKey.BATCH_COUNT: 4,
+                    TaskExecCounterKey.RECORD_COUNT: task.end - task.start,
+                },
+            )
+        assert client.retry_stats.retries >= 1
+        faults.clear()
+
+        # Checkpoint plane: a real save/restore plus the master's
+        # shard-progress persister.
+        saver = CheckpointSaver(str(tmp_path / "ckpt"), keep_max=2)
+        saver.save({"w": [1.0, 2.0]}, step=1)
+        state, step = saver.load_latest()
+        assert (step, state) == (1, {"w": [1.0, 2.0]})
+        persister = TaskProgressPersister(task_manager, str(tmp_path / "ckpt"))
+        persister.persist_now()
+
+        # Pod plane: a worker that crashes once and is relaunched.
+        flaky = tmp_path / "flaky_worker.py"
+        flaky.write_text(
+            "import os, sys\n"
+            "sentinel = sys.argv[1]\n"
+            "if os.path.exists(sentinel):\n"
+            "    sys.exit(0)\n"
+            "open(sentinel, 'w').close()\n"
+            "sys.exit(1)\n"
+        )
+        manager = LocalProcessManager(
+            num_workers=1,
+            worker_argv_fn=lambda wid: [
+                sys.executable, str(flaky), str(tmp_path / "sentinel"),
+            ],
+            max_restarts=2,
+            poll_interval_s=0.05,
+        )
+        manager.start()
+        assert manager.wait(timeout=120) is True
+        manager.stop()
+
+        # --- correctness: exact deltas on the registry ------------------
+        assert m_task_duration.count(type="TRAINING") == base_train_done + 2
+        assert m_formation.count() == base_formations + 1
+        assert m_retries.value(method="get_task") >= base_retries + 1
+        assert m_relaunches.value(reason="crash") >= base_crashes + 1
+        assert m_saves.count(kind="full") == base_full_saves + 1
+        assert m_saves.count(kind="task_progress") == base_progress_saves + 1
+        assert m_restores.count(kind="full") == base_restores + 1
+
+        # --- the acceptance scrape: every family exposed over HTTP ------
+        status, text = _get(f"http://127.0.0.1:{exporter.port}/metrics")
+        assert status == 200
+        # Task-latency histogram with real observations.
+        assert '# TYPE elasticdl_task_duration_seconds histogram' in text
+        assert 'elasticdl_task_duration_seconds_count{type="TRAINING"} ' in text
+        # Rendezvous epoch counter + world-size gauge.
+        assert "\nelasticdl_rendezvous_epochs_total " in text
+        assert "\nelasticdl_world_size 1" in text
+        assert (
+            "\nelasticdl_rendezvous_formation_duration_seconds_count " in text
+        )
+        # Pod relaunch counter (the crash was counted by cause).
+        assert 'elasticdl_worker_relaunches_total{reason="crash"} ' in text
+        # RPC retry counters (folded RetryStats).
+        assert 'elasticdl_rpc_retries_total{method="get_task"} ' in text
+        # Checkpoint duration metrics, both kinds.
+        assert (
+            'elasticdl_checkpoint_save_duration_seconds_count{kind="full"} '
+            in text
+        )
+        assert (
+            'elasticdl_checkpoint_save_duration_seconds_count'
+            '{kind="task_progress"} ' in text
+        )
+        assert (
+            'elasticdl_checkpoint_restore_duration_seconds_count'
+            '{kind="full"} ' in text
+        )
+        # Job throughput gauges derived from worker exec counters.
+        assert "\nelasticdl_job_examples_per_second " in text
+        assert "\nelasticdl_job_steps_per_second " in text
+        # Dispatch/completion counters moved through the whole job.
+        assert "\nelasticdl_tasks_dispatched_total " in text
+
+        # /debug/vars carries the same metrics as JSON.
+        status, body = _get(f"http://127.0.0.1:{exporter.port}/debug/vars")
+        debug = json.loads(body)
+        assert "elasticdl_task_duration_seconds" in debug["metrics"]
+    finally:
+        faults.clear()
+        exporter.stop()
+        client.close()
+        server.stop(grace=None)
