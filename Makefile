@@ -46,9 +46,13 @@ test-fast: lint
 
 # Observability plane gate (docs/observability.md): registry semantics +
 # lockcheck concurrency, exporter endpoint round-trip, journal rotation,
-# and the master end-to-end acceptance scrape.
+# the master end-to-end acceptance scrape, and the worker telemetry
+# plane (heartbeat snapshots, straggler detection, trace correlation,
+# obs.top) — then the journal schema validator's selftest.
 test-obs:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
+	       tests/test_telemetry.py -q
+	python scripts/validate_journal.py --selftest
 
 # Transient-failure resilience gate: deterministic fault injection
 # (common/faults.py) + the master-SIGKILL / torn-checkpoint chaos e2e.

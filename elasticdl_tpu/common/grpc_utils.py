@@ -48,6 +48,41 @@ _RPC_GIVE_UPS = obs.counter(
     labelnames=("method",),
 )
 
+# ---------------------------------------------------------------------------
+# Cross-process trace correlation
+# ---------------------------------------------------------------------------
+
+#: gRPC metadata key carrying a per-task trace id across the
+#: master/worker process boundary.  The master's TaskManager mints the id
+#: at dispatch (it rides GetTaskResponse.task.trace_id); the worker sends
+#: it BACK as call metadata on report_task_result, and both ends stamp it
+#: on their journal/span records — so `get_task -> train ->
+#: report_task_result -> requeue/complete` reconstructs as one causal
+#: chain (docs/observability.md).  Lowercase per the gRPC metadata spec.
+TRACE_METADATA_KEY = "elasticdl-trace-id"
+
+
+def trace_metadata(trace_id: str) -> Optional[Tuple[Tuple[str, str], ...]]:
+    """Call-metadata tuple carrying `trace_id` (None when empty, so
+    callers can pass the result straight to `call_with_retry`)."""
+    if not trace_id:
+        return None
+    return ((TRACE_METADATA_KEY, str(trace_id)),)
+
+
+def trace_id_from_context(context) -> str:
+    """Extract the trace id from a servicer context's invocation
+    metadata ('' when absent — old workers, non-task RPCs)."""
+    try:
+        metadata = context.invocation_metadata()
+    except Exception:
+        return ""
+    for key, value in metadata or ():
+        if key == TRACE_METADATA_KEY:
+            return value
+    return ""
+
+
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
     ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
@@ -279,6 +314,7 @@ def call_with_retry(
     sleep: Callable[[float], None] = time.sleep,
     clock: Callable[[], float] = time.monotonic,
     seed: str = "",
+    metadata: Optional[Tuple[Tuple[str, str], ...]] = None,
 ):
     """Invoke `rpc_callable(request, timeout=...)` under `policy`.
 
@@ -287,7 +323,9 @@ def call_with_retry(
     total budget last.  The `rpc.<method>` fault-injection site fires
     once per ATTEMPT (so `error=...@1x3` means "first three attempts
     fail"), before the wire call, and is a no-op when faults are
-    disarmed.
+    disarmed.  `metadata` (e.g. `trace_metadata(...)`) is forwarded to
+    every attempt; None sends none — keeping the common path compatible
+    with test doubles that only accept (request, timeout, wait_for_ready).
     """
     if stats is not None:
         stats.record_call()
@@ -301,10 +339,12 @@ def call_with_retry(
             spec = faults.fire(f"rpc.{method}")
             if spec is not None:
                 _apply_rpc_fault(spec, sleep)
+            kwargs = {} if metadata is None else {"metadata": metadata}
             return rpc_callable(
                 request,
                 timeout=policy.timeout_s,
                 wait_for_ready=policy.wait_for_ready,
+                **kwargs,
             )
         except grpc.RpcError as exc:
             code = exc.code() if callable(getattr(exc, "code", None)) else None

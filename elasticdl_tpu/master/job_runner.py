@@ -216,6 +216,10 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
             worker_env[key.strip()] = value
     manager = _build_worker_manager(args, master, rendezvous, worker_env)
     master.pod_manager = manager  # type: ignore[attr-defined]
+    if master.telemetry is not None:
+        # Straggler advisories from the telemetry plane flow to the pod
+        # manager (advisory only — see ElasticWorkerManager.note_straggler).
+        master.telemetry.add_straggler_callback(manager.note_straggler)
     if master.tensorboard_service is not None:
         master.tensorboard_service.bind(
             restarts_fn=lambda: manager.restarts_used

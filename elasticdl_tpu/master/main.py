@@ -40,6 +40,7 @@ class Master:
     progress_persister: object = None
     tensorboard_service: object = None
     metrics_exporter: object = None
+    telemetry: object = None
 
     @property
     def addr(self) -> str:
@@ -164,10 +165,25 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
             tensorboard_service=tensorboard_service,
         )
 
+    # Worker telemetry plane: snapshots arriving on liveness heartbeats
+    # aggregate here (fleet gauges + straggler detection).  Scoped to the
+    # current world when a rendezvous exists, so reports from torn-down
+    # worlds neither skew aggregates nor read as infinitely stale.
+    from elasticdl_tpu.obs.telemetry import TelemetryAggregator
+
+    telemetry = TelemetryAggregator(
+        current_workers_fn=(
+            (lambda: [wid for wid, _h in rendezvous_server.world()])
+            if rendezvous_server is not None
+            else None
+        )
+    )
+
     servicer = MasterServicer(
         task_manager=task_manager,
         evaluation_service=evaluation_service,
         rendezvous_server=rendezvous_server,
+        telemetry=telemetry,
     )
     if tensorboard_service is not None:
         tensorboard_service.bind(
@@ -204,6 +220,7 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
         data_reader=training_reader,
         progress_persister=progress_persister,
         tensorboard_service=tensorboard_service,
+        telemetry=telemetry,
     )
     return master
 

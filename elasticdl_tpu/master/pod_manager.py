@@ -117,6 +117,14 @@ class ElasticWorkerManager:
             "elasticdl_hung_worker_kills_total",
             "Workers killed for silent heartbeats (hang -> churn)",
         )
+        self._m_straggler_advisories = obs.counter(
+            "elasticdl_straggler_advisories_total",
+            "Straggler advisories received from the telemetry plane",
+        )
+        # Workers the telemetry plane currently flags as stragglers —
+        # ADVISORY state for operators/schedulers (current_straggler_ids);
+        # the liveness-timeout kill remains the only enforcement path.
+        self._straggler_ids: set = set()  # guarded-by: _lock
         # Gauge callbacks read fields without the manager lock: a scrape
         # must never couple the exporter to the supervision lock, and the
         # len()/int reads are atomic enough for a monitoring sample.
@@ -191,6 +199,37 @@ class ElasticWorkerManager:
         with self._lock:
             return [h.worker_id for h in self._handles]
 
+    def note_straggler(self, worker_id: int, flagged: bool, evidence=None):
+        """Advisory hook for the telemetry plane's straggler detector
+        (obs/telemetry.TelemetryAggregator.add_straggler_callback).
+        Deliberately does NOT kill: a straggler is making progress —
+        killing it restarts the whole world and replays its in-flight
+        work, usually worse than riding out the slowness.  The advisory
+        is recorded (counter + log + `current_straggler_ids`) so
+        operators and future scheduling policies can act on it; genuine
+        hangs are still converted to churn by the liveness-timeout kill
+        (_kill_stale_workers)."""
+        with self._lock:
+            if flagged:
+                self._straggler_ids.add(worker_id)
+            else:
+                self._straggler_ids.discard(worker_id)
+        if flagged:
+            self._m_straggler_advisories.inc()
+            logger.warning(
+                "Telemetry advisory: worker %d is straggling (%s); not "
+                "killing — liveness timeout remains the enforcement path",
+                worker_id, evidence or {},
+            )
+        else:
+            logger.info(
+                "Telemetry advisory: worker %d straggler cleared", worker_id
+            )
+
+    def current_straggler_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._straggler_ids)
+
     def kill_worker(self, worker_id: int, sig: int = 9):
         """Fault injection / preemption simulation: kill one worker."""
         with self._lock:
@@ -234,6 +273,10 @@ class ElasticWorkerManager:
                 return
             worker_ids = list(range(self._next_worker_id, self._next_worker_id + n))
             self._next_worker_id += n
+            # Straggler advisories die with the world: ids are never
+            # reused, so a flagged worker that churned would otherwise
+            # sit in the advisory set forever.
+            self._straggler_ids.intersection_update(worker_ids)
         if self._rendezvous is not None:
             self._rendezvous.set_worker_hosts(
                 [(wid, self._worker_host(wid)) for wid in worker_ids]

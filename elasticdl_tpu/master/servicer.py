@@ -4,12 +4,18 @@ Parity: elasticdl/python/master/servicer.py in the reference — get_task /
 report_task_result / report_evaluation_metrics / report_version /
 get_comm_rank, plus (TPU rebuild) worker liveness heartbeats feeding the
 elastic rendezvous and shard-progress checkpoints for master resume.
+
+Observability hooks: liveness heartbeats carry worker-telemetry
+snapshots which land in the TelemetryAggregator (obs/telemetry.py), and
+report_task_result reads the worker-echoed trace id from gRPC metadata
+so the task-lifecycle journal chain spans the process boundary.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+from elasticdl_tpu.common.grpc_utils import trace_id_from_context
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.proto.service import MasterServicer as _Base
@@ -24,11 +30,13 @@ class MasterServicer(_Base):
         evaluation_service=None,
         rendezvous_server=None,
         checkpoint_service=None,
+        telemetry=None,
     ):
         self._task_manager = task_manager
         self._evaluation_service = evaluation_service
         self._rendezvous_server = rendezvous_server
         self._checkpoint_service = checkpoint_service
+        self._telemetry = telemetry
         self._model_version = 0
         self._zero_task_warned: set = set()
 
@@ -51,6 +59,7 @@ class MasterServicer(_Base):
             success,
             worker_id=request.worker_id,
             exec_counters=dict(request.exec_counters),
+            trace_id=trace_id_from_context(context),
         )
         if not success:
             logger.warning(
@@ -118,6 +127,10 @@ class MasterServicer(_Base):
             should_reset = self._rendezvous_server.report_liveness(
                 request.worker_id, request.host, request.rendezvous_id
             )
+        if self._telemetry is not None and request.telemetry_json:
+            # Telemetry rides the heartbeat; ingest never raises (a
+            # malformed snapshot must not fail the liveness plane).
+            self._telemetry.ingest(request.worker_id, request.telemetry_json)
         return pb.ReportWorkerLivenessResponse(should_reset=should_reset)
 
     # ------------------------------------------------------------------

@@ -15,7 +15,9 @@ restarted master resumes mid-epoch (see `to_checkpoint`/`from_checkpoint`).
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -29,6 +31,12 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 logger = get_logger("master.task_manager")
+
+#: Process-wide TaskManager sequence: trace-id prefixes must differ
+#: between manager instances in ONE process (tests, master resume
+#: rebuilding the manager) — task ids restart at 1 per manager, so the
+#: pid alone would mint colliding trace ids.
+_MANAGER_SEQ = itertools.count()
 
 
 class _TaskManagerMetrics:
@@ -115,7 +123,7 @@ class _Task:
     epoch: int = 0
     retry_count: int = 0
 
-    def to_proto(self, task_id: int) -> pb.Task:
+    def to_proto(self, task_id: int, trace_id: str = "") -> pb.Task:
         return pb.Task(
             task_id=task_id,
             shard_name=self.shard_name,
@@ -124,6 +132,7 @@ class _Task:
             type=self.type,
             model_version=self.model_version,
             epoch=self.epoch,
+            trace_id=trace_id,
         )
 
     def to_json(self) -> dict:
@@ -173,8 +182,13 @@ class TaskManager:
         self._max_task_retries = max_task_retries
 
         self._todo: deque = deque()  # guarded-by: _lock
-        self._doing: Dict[int, Tuple[int, _Task, float]] = {}  # guarded-by: _lock
+        # task_id -> (worker_id, task, dispatch time, trace_id)
+        self._doing: Dict[int, Tuple[int, _Task, float, str]] = {}  # guarded-by: _lock
         self._task_id = 0  # guarded-by: _lock
+        # Trace-id prefix: distinguishes dispatches across master restarts
+        # (pid) AND across manager instances within one process (seq) —
+        # task ids restart at 1 in both cases — without wall-clock input.
+        self._trace_prefix = f"{os.getpid():x}.{next(_MANAGER_SEQ)}"
         self._epoch = 0  # guarded-by: _lock
         self._finished_record_count = 0  # guarded-by: _lock
         self._recovered_record_count = 0  # guarded-by: _lock
@@ -298,9 +312,27 @@ class TaskManager:
                 task = self._todo.popleft()
                 self._task_id += 1
                 task_id = self._task_id
-                self._doing[task_id] = (worker_id, task, time.time())
+                # One trace id per DISPATCH (task ids are already unique
+                # per dispatch — a requeued task re-dispatches under a
+                # fresh id); the worker stamps it on its spans and echoes
+                # it back as gRPC metadata on report_task_result.
+                trace_id = f"t-{self._trace_prefix}-{task_id}"
+                self._doing[task_id] = (worker_id, task, time.time(), trace_id)
                 self._metrics.dispatched.inc()
-                return task.to_proto(task_id)
+                journal_events.append(
+                    dict(
+                        event="task_dispatch",
+                        task_id=task_id,
+                        worker_id=worker_id,
+                        trace_id=trace_id,
+                        type=_TaskManagerMetrics.task_type_name(task.type),
+                        shard=task.shard_name,
+                        start=task.start,
+                        end=task.end,
+                        epoch=task.epoch,
+                    )
+                )
+                return task.to_proto(task_id, trace_id=trace_id)
         finally:
             # Journal writes happen outside the dispatch lock (file I/O
             # must never extend control-plane lock holds).
@@ -321,8 +353,14 @@ class TaskManager:
                 self._run_done_callbacks(done_callbacks)
 
     def report(self, task_id: int, success: bool, worker_id: int = -1,
-               exec_counters: Optional[Dict[str, int]] = None) -> bool:
+               exec_counters: Optional[Dict[str, int]] = None,
+               trace_id: str = "") -> bool:
         """Mark a task done/failed. Failed tasks go back to `todo`.
+
+        `trace_id` is the id the WORKER echoed back (gRPC metadata); the
+        dispatch-minted id stored in `doing` is authoritative for the
+        journal chain — a mismatch (reordered report after a requeue)
+        is journaled as `reported_trace_id` rather than trusted.
 
         Returns True if the task_id was a known in-flight task.
         """
@@ -332,16 +370,29 @@ class TaskManager:
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
-                logger.warning("Report for unknown/expired task %d", task_id)
+                logger.warning(
+                    "Report for unknown/expired task %d%s", task_id,
+                    f" (trace {trace_id})" if trace_id else "",
+                )
                 return False
-            owner, task, _start = entry
+            owner, task, _start, stored_trace = entry
             type_name = _TaskManagerMetrics.task_type_name(task.type)
-            self._metrics.duration.observe(
-                time.time() - _start, type=type_name
-            )
+            duration_s = time.time() - _start
+            self._metrics.duration.observe(duration_s, type=type_name)
             eval_done_cbs = []
             if success:
                 self._metrics.completed.inc(type=type_name)
+                done_event = dict(
+                    event="task_done",
+                    task_id=task_id,
+                    worker_id=worker_id,
+                    trace_id=stored_trace,
+                    type=type_name,
+                    duration_s=round(duration_s, 6),
+                )
+                if trace_id and trace_id != stored_trace:
+                    done_event["reported_trace_id"] = trace_id
+                journal_events.append(done_event)
                 batches = (exec_counters or {}).get(
                     TaskExecCounterKey.BATCH_COUNT, 0
                 )
@@ -387,6 +438,7 @@ class TaskManager:
                     dict(
                         event="task_failed_permanently",
                         task_id=task_id,
+                        trace_id=stored_trace,
                         shard=task.shard_name,
                         start=task.start,
                         end=task.end,
@@ -406,6 +458,7 @@ class TaskManager:
                         event="task_requeue",
                         reason="failure",
                         task_id=task_id,
+                        trace_id=stored_trace,
                         worker_id=worker_id,
                         retry=task.retry_count,
                     )
@@ -454,10 +507,13 @@ class TaskManager:
         """Requeue all tasks in-flight on a dead/removed worker."""
         with self._lock:
             recovered = [
-                tid for tid, (owner, _t, _s) in self._doing.items() if owner == worker_id
+                tid for tid, (owner, _t, _s, _tr) in self._doing.items()
+                if owner == worker_id
             ]
+            trace_ids = []
             for tid in recovered:
-                _owner, task, _start = self._doing.pop(tid)
+                _owner, task, _start, trace_id = self._doing.pop(tid)
+                trace_ids.append(trace_id)
                 self._todo.appendleft(task)
                 if task.type == pb.TRAINING:
                     self._recovered_record_count += task.end - task.start
@@ -474,6 +530,7 @@ class TaskManager:
                 reason="worker_churn",
                 worker_id=worker_id,
                 task_ids=recovered,
+                trace_ids=trace_ids,
             )
         return len(recovered)
 
@@ -485,12 +542,12 @@ class TaskManager:
         now = time.time()
         expired = [
             tid
-            for tid, (_owner, _task, start) in self._doing.items()
+            for tid, (_owner, _task, start, _tr) in self._doing.items()
             if now - start > self._task_timeout_s
         ]
         events = []
         for tid in expired:
-            owner, task, _start = self._doing.pop(tid)
+            owner, task, _start, trace_id = self._doing.pop(tid)
             self._todo.appendleft(task)
             if task.type == pb.TRAINING:
                 self._recovered_record_count += task.end - task.start
@@ -500,6 +557,7 @@ class TaskManager:
                     event="task_requeue",
                     reason="timeout",
                     task_id=tid,
+                    trace_id=trace_id,
                     worker_id=owner,
                     timeout_s=self._task_timeout_s,
                 )
@@ -591,7 +649,7 @@ class TaskManager:
         """JSON snapshot; `doing` tasks are treated as todo (at-least-once)."""
         with self._lock:
             todo = [t.to_json() for t in self._todo]
-            todo.extend(t.to_json() for (_w, t, _s) in self._doing.values())
+            todo.extend(t.to_json() for (_w, t, _s, _tr) in self._doing.values())
             return json.dumps(
                 {
                     "epoch": self._epoch,

@@ -26,6 +26,14 @@ Conventions (docs/observability.md):
 The exporter (obs/exporter.py, `--metrics_port` on the master) serves the
 default registry and journal; `init_journal` points the journal at its
 JSONL file (one per master, under the TensorBoard log dir).
+
+The worker telemetry plane (obs/telemetry.py) builds on these pieces:
+workers ship WorkerTelemetry snapshots on the liveness heartbeat, the
+master's TelemetryAggregator folds fleet aggregates into this registry
+(per-worker detail is journal-only per the cardinality rule), and
+`python -m elasticdl_tpu.obs.top` renders the per-worker view from the
+exporter's /metrics + /journal.  Imported lazily here to keep the base
+obs import free of the telemetry module (analysis tooling imports obs).
 """
 
 from __future__ import annotations

@@ -1,16 +1,20 @@
 """Master-embedded observability HTTP exporter.
 
-Serves the standard production triad on `--metrics_port`:
+Serves the standard production surface on `--metrics_port`:
 
     /metrics      Prometheus text exposition (0.0.4) of the registry
     /healthz      liveness JSON ({"status": "ok", "uptime_s": ...})
+    /journal      last-N journal events as JSON (?n=, bounded tail; no
+                  file paths — safe to expose beyond the master host)
     /debug/vars   JSON dump of every metric + the journal's recent tail
 
-Stdlib `http.server` only — no new dependencies.  Requests are handled on
-named daemon threads (thread-hygiene rule: stack dumps from a stuck
-master must attribute exporter threads, and a scrape in flight must never
-hold up process exit).  Scrapes read registry snapshots; they never block
-on control-plane service locks beyond the per-metric copy (see
+All endpoints answer HEAD with headers only (load balancers and
+liveness probes HEAD before they GET).  Stdlib `http.server` only — no
+new dependencies.  Requests are handled on named daemon threads
+(thread-hygiene rule: stack dumps from a stuck master must attribute
+exporter threads, and a scrape in flight must never hold up process
+exit).  Scrapes read registry snapshots; they never block on
+control-plane service locks beyond the per-metric copy (see
 obs/metrics.py locking notes).
 """
 
@@ -87,6 +91,9 @@ class MetricsExporter:
             def do_GET(self):  # noqa: N802 — http.server API
                 exporter._handle(self)
 
+            def do_HEAD(self):  # noqa: N802 — http.server API
+                exporter._handle(self, head=True)
+
             def log_message(self, format, *args):
                 pass  # scrape traffic must not spam the master log
 
@@ -118,8 +125,23 @@ class MetricsExporter:
 
     # ------------------------------------------------------------------
 
-    def _handle(self, request: BaseHTTPRequestHandler):
-        path = request.path.split("?", 1)[0]
+    #: Upper bound on ?n= for /journal: the in-memory ring is itself
+    #: bounded, but a hostile/buggy scraper must not size the response.
+    JOURNAL_TAIL_MAX = 1000
+
+    def _journal_tail_n(self, query: str) -> int:
+        n = self._journal_tail
+        for pair in query.split("&"):
+            if pair.startswith("n="):
+                try:
+                    n = int(pair[2:])
+                except ValueError:
+                    pass
+        return max(1, min(n, self.JOURNAL_TAIL_MAX))
+
+    def _handle(self, request: BaseHTTPRequestHandler, head: bool = False):
+        path, _, query = request.path.partition("?")
+        status = 200
         try:
             if path == "/metrics":
                 body = self._registry.render_prometheus().encode("utf-8")
@@ -132,6 +154,15 @@ class MetricsExporter:
                             time.monotonic() - self._started_monotonic, 3
                         ),
                     }
+                ).encode("utf-8")
+                content_type = "application/json"
+            elif path == "/journal":
+                # Events only — deliberately no journal file path: this
+                # endpoint may be exposed beyond the master host and the
+                # master's filesystem layout is nobody's business.
+                events = self._journal.tail(self._journal_tail_n(query))
+                body = json.dumps(
+                    {"events": events, "count": len(events)}, default=str
                 ).encode("utf-8")
                 content_type = "application/json"
             elif path == "/debug/vars":
@@ -147,13 +178,12 @@ class MetricsExporter:
                 ).encode("utf-8")
                 content_type = "application/json"
             else:
-                body = b"not found (try /metrics, /healthz, /debug/vars)\n"
-                request.send_response(404)
-                request.send_header("Content-Type", "text/plain")
-                request.send_header("Content-Length", str(len(body)))
-                request.end_headers()
-                request.wfile.write(body)
-                return
+                status = 404
+                body = (
+                    b"not found (try /metrics, /healthz, /journal, "
+                    b"/debug/vars)\n"
+                )
+                content_type = "text/plain"
         except Exception:
             # A scrape failure is the exporter's bug, never the master's:
             # answer 500 and keep serving.
@@ -163,8 +193,9 @@ class MetricsExporter:
             except OSError:
                 pass
             return
-        request.send_response(200)
+        request.send_response(status)
         request.send_header("Content-Type", content_type)
         request.send_header("Content-Length", str(len(body)))
         request.end_headers()
-        request.wfile.write(body)
+        if not head:
+            request.wfile.write(body)

@@ -381,6 +381,20 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
+    def snapshot(self) -> Dict[str, "_Metric"]:
+        """Name -> metric map copy, for save/restore test isolation (the
+        conftest `obs_registry_snapshot` fixture).  Restore, don't clear:
+        module-level metric objects (e.g. the RPC retry counters bound at
+        import) must keep their registry membership across tests."""
+        with self._lock:
+            return dict(self._metrics)
+
+    def restore(self, saved: Dict[str, "_Metric"]):
+        """Put a `snapshot()` back, dropping metrics registered since."""
+        with self._lock:
+            self._metrics.clear()
+            self._metrics.update(saved)
+
 
 class RateTracker:
     """Sliding-window throughput over an event feed: `add(n)` on each

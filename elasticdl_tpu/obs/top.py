@@ -1,0 +1,205 @@
+"""``python -m elasticdl_tpu.obs.top`` — live per-worker status table.
+
+Renders the worker telemetry plane from a running master's exporter
+(``--metrics_port``): fleet aggregates from ``/metrics`` (Prometheus
+text) and the per-worker detail from ``/journal`` (the bounded event
+tail, where ``worker_telemetry`` / ``straggler_*`` events carry the
+per-worker fields that — per the cardinality rule — never become metric
+labels).
+
+    python -m elasticdl_tpu.obs.top --addr localhost:9090
+    python -m elasticdl_tpu.obs.top --addr localhost:9090 --once
+
+Stdlib only, read-only, and safe against a mid-scrape master restart
+(connection errors render as a status line, not a crash).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+#: /metrics families summarized in the header line.
+_HEADER_GAUGES = (
+    ("elasticdl_world_size", "world"),
+    ("elasticdl_tasks_todo", "todo"),
+    ("elasticdl_tasks_doing", "doing"),
+    ("elasticdl_job_examples_per_second", "job ex/s"),
+    ("elasticdl_stragglers", "stragglers"),
+    ("elasticdl_telemetry_staleness_seconds", "max stale(s)"),
+)
+
+_COLUMNS = (
+    "WORKER", "AGE(s)", "P50(ms)", "P95(ms)", "EX/S",
+    "TASK", "PROGRESS", "RDZV", "RETRY", "STATE",
+)
+
+
+def fetch_text(url: str, timeout_s: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout_s) as response:
+        return response.read().decode("utf-8", errors="replace")
+
+
+def parse_metrics(text: str) -> Dict[str, float]:
+    """Minimal Prometheus text parser: unlabeled samples only (all the
+    fleet aggregates this tool reads are unlabeled gauges)."""
+    values: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#") or "{" in line:
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            continue
+        try:
+            values[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return values
+
+
+def worker_rows(
+    events: List[dict], now: Optional[float] = None
+) -> List[dict]:
+    """Fold the journal tail into one row per worker: the latest
+    ``worker_telemetry`` snapshot plus straggler state from the most
+    recent ``straggler_detected``/``straggler_cleared`` transition."""
+    now = time.time() if now is None else now
+    latest: Dict[int, dict] = {}
+    straggling: Dict[int, dict] = {}
+    for event in events:
+        kind = event.get("event")
+        wid = event.get("worker_id")
+        if wid is None:
+            continue
+        if kind == "worker_telemetry":
+            latest[wid] = event
+        elif kind == "straggler_detected":
+            straggling[wid] = event
+        elif kind == "straggler_cleared":
+            straggling.pop(wid, None)
+    rows = []
+    for wid in sorted(latest):
+        event = latest[wid]
+        task = event.get("task") or {}
+        total = task.get("records_total") or 0
+        done = task.get("records_done") or 0
+        progress = f"{done}/{total}" if total else "-"
+        state = "ok"
+        if wid in straggling:
+            state = f"STRAGGLER({straggling[wid].get('metric', '?')})"
+        rows.append(
+            {
+                "worker": wid,
+                "age_s": round(max(0.0, now - float(event.get("ts", now))), 1),
+                "p50_ms": _ms(event.get("step_p50_s")),
+                "p95_ms": _ms(event.get("step_p95_s")),
+                "examples_per_s": event.get("examples_per_s", 0.0),
+                "task": task.get("id", -1),
+                "progress": progress,
+                "rendezvous_id": event.get("rendezvous_id", 0),
+                "retries": (event.get("rpc") or {}).get("retries", 0),
+                "state": state,
+            }
+        )
+    return rows
+
+
+def _ms(seconds) -> str:
+    if seconds is None:
+        return "-"
+    return f"{float(seconds) * 1e3:.1f}"
+
+
+def render(
+    rows: List[dict], metrics: Dict[str, float], addr: str = ""
+) -> str:
+    """One status frame as plain text (also the --once output)."""
+    header_bits = []
+    for name, label in _HEADER_GAUGES:
+        if name in metrics:
+            value = metrics[name]
+            formatted = (
+                str(int(value)) if float(value).is_integer() else f"{value:.1f}"
+            )
+            header_bits.append(f"{label}={formatted}")
+    lines = [
+        f"elasticdl top — {addr}  " + "  ".join(header_bits),
+    ]
+    table: List[Tuple[str, ...]] = [_COLUMNS]
+    for row in rows:
+        table.append(
+            (
+                str(row["worker"]),
+                f"{row['age_s']:.1f}",
+                str(row["p50_ms"]),
+                str(row["p95_ms"]),
+                f"{row['examples_per_s']:.1f}",
+                str(row["task"]),
+                str(row["progress"]),
+                str(row["rendezvous_id"]),
+                str(row["retries"]),
+                row["state"],
+            )
+        )
+    widths = [
+        max(len(line[col]) for line in table) for col in range(len(_COLUMNS))
+    ]
+    for line in table:
+        lines.append(
+            "  ".join(cell.ljust(width) for cell, width in zip(line, widths))
+            .rstrip()
+        )
+    if not rows:
+        lines.append("(no worker_telemetry events in the journal tail yet)")
+    return "\n".join(lines)
+
+
+def snapshot_frame(addr: str, tail: int = 256) -> str:
+    base = addr if "://" in addr else f"http://{addr}"
+    metrics = parse_metrics(fetch_text(base + "/metrics"))
+    journal = json.loads(fetch_text(f"{base}/journal?n={tail}"))
+    return render(worker_rows(journal.get("events", [])), metrics, addr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.obs.top",
+        description="Live per-worker status from a master's metrics port.",
+    )
+    parser.add_argument(
+        "--addr", default="localhost:9090",
+        help="host:port of the master's --metrics_port exporter",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh interval in seconds",
+    )
+    parser.add_argument(
+        "--tail", type=int, default=256,
+        help="journal events to fold per frame",
+    )
+    parser.add_argument(
+        "--once", action="store_true", help="print one frame and exit"
+    )
+    args = parser.parse_args(argv)
+    while True:
+        try:
+            frame = snapshot_frame(args.addr, args.tail)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            frame = f"elasticdl top — {args.addr} unreachable: {exc}"
+        if args.once:
+            print(frame)
+            return 0
+        # ANSI clear + home keeps the table in place like top(1).
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

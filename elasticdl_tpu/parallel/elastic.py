@@ -131,9 +131,19 @@ def join_world(
 class HeartbeatReporter:
     """Background liveness heartbeats to the master (failure-detection
     plane: the pod manager kills workers whose heartbeats go silent, which
-    converts hangs into the process-exit signal churn handling reacts to)."""
+    converts hangs into the process-exit signal churn handling reacts to).
+
+    The heartbeat is also the TELEMETRY CARRIER: when a WorkerTelemetry
+    collector (obs/telemetry.py) is attached, each beat ships its bounded
+    snapshot in `ReportWorkerLivenessRequest.telemetry_json` — per-worker
+    observability with zero new RPCs.  Intervals carry ±`JITTER` of
+    deterministic per-worker jitter so a fleet that just re-formed (every
+    worker's clock started at the same rendezvous barrier) doesn't
+    heartbeat the master in lockstep."""
 
     WARN_INTERVAL_S = 60.0
+    #: Fractional interval jitter (0.2 = ±20%).
+    JITTER = 0.2
 
     def __init__(
         self,
@@ -141,6 +151,8 @@ class HeartbeatReporter:
         world: WorldInfo,
         host: str = "",
         interval_s: float = 5.0,
+        telemetry=None,
+        jitter: float = JITTER,
     ):
         import threading
 
@@ -148,6 +160,8 @@ class HeartbeatReporter:
         self._world = world
         self._host = host or advertised_host()
         self._interval_s = interval_s
+        self._telemetry = telemetry
+        self._jitter = float(jitter)
         self._stop = threading.Event()
         #: Consecutive/total failed heartbeats (tests and ops read these —
         #: a silently-dead liveness plane looks exactly like a healthy one
@@ -165,12 +179,38 @@ class HeartbeatReporter:
     def stop(self):
         self._stop.set()
 
+    def jittered_interval_s(self, tick: int) -> float:
+        """Interval for beat `tick`: uniform in [1-J, 1+J] x interval,
+        seeded from (worker, tick) — deterministic per worker (replayable
+        schedules, same rule as the RPC backoff jitter) yet decorrelated
+        across the fleet."""
+        if not self._jitter:
+            return self._interval_s
+        import random
+
+        u = random.Random(f"hb:{self._mc.worker_id}:{tick}").random()
+        return self._interval_s * (1.0 - self._jitter + 2.0 * self._jitter * u)
+
     def _loop(self):
-        while not self._stop.wait(self._interval_s):
+        tick = 0
+        while not self._stop.wait(self.jittered_interval_s(tick)):
+            tick += 1
+            payload = ""
+            if self._telemetry is not None:
+                try:
+                    payload = self._telemetry.snapshot_json()
+                except Exception:
+                    payload = ""  # telemetry must never kill the liveness plane
             try:
-                self._mc.report_worker_liveness(
-                    self._host, self._world.rendezvous_id
-                )
+                if payload:
+                    self._mc.report_worker_liveness(
+                        self._host, self._world.rendezvous_id,
+                        telemetry_json=payload,
+                    )
+                else:
+                    self._mc.report_worker_liveness(
+                        self._host, self._world.rendezvous_id
+                    )
             except Exception as exc:
                 # Master unreachable: the process-manager side owns the
                 # failure, but say so (rate-limited) — a heartbeat plane
@@ -239,6 +279,12 @@ def broadcast_task(
     encoded = multihost_utils.broadcast_one_to_all(
         _encode_task(task, shard_names), is_source=world.is_leader
     )
+    if world.is_leader and task is not None:
+        # The leader keeps its ORIGINAL task object: the fixed-shape
+        # encoding drops string fields (trace_id), and the leader is the
+        # only rank that reports results — its trace id must survive the
+        # broadcast round-trip.
+        return task
     return _decode_task(np.asarray(encoded), shard_names)
 
 

@@ -23,6 +23,7 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from elasticdl_tpu import obs
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.constants import Mode, TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
@@ -56,12 +57,17 @@ class CollectiveWorker:
         prediction_data_reader=None,
         profiler=None,
         train_window_steps: int = 0,
+        telemetry=None,
     ):
         self._mc = master_client
         self._spec = model_spec
         self._mb = minibatch_size
         self._world = world
         self._trainer = trainer
+        # Worker-side telemetry collector (obs/telemetry.WorkerTelemetry):
+        # step times / task progress recorded here ride the heartbeat to
+        # the master's aggregator.  None = telemetry plane off (tests).
+        self._telemetry = telemetry
         # Each process supplies `block` rows per collective step (>= mb,
         # rounded up to divide its local device count).
         self._block = trainer.local_block(minibatch_size)
@@ -163,7 +169,9 @@ class CollectiveWorker:
             )
 
     def run(self):
-        heartbeat = elastic.HeartbeatReporter(self._mc, self._world).start()
+        heartbeat = elastic.HeartbeatReporter(
+            self._mc, self._world, telemetry=self._telemetry
+        ).start()
         try:
             self._run_task_loop()
         finally:
@@ -223,7 +231,27 @@ class CollectiveWorker:
             if spec is not None and spec.kind == "crash":
                 faults.crash_now(spec)
             try:
-                counters = self._process_task(task)
+                type_name = pb.TaskType.Name(task.type)
+            except ValueError:
+                type_name = "UNKNOWN"
+            if self._telemetry is not None:
+                self._telemetry.begin_task(
+                    task.task_id, type_name, task.end - task.start
+                )
+            # The span closes the worker half of the trace chain: its
+            # journal record carries the dispatch-minted trace id (leader
+            # ranks — the fixed-shape broadcast drops strings, so
+            # non-leader ranks span without one).  Same name+labelset as
+            # the Local-mode worker's span: both paths share one
+            # histogram family in-process.
+            span_fields = dict(task_id=task.task_id, rank=self._world.rank)
+            if task.trace_id:
+                span_fields["trace_id"] = task.trace_id
+            try:
+                with obs.span(
+                    "worker.task", labels={"type": type_name}, **span_fields
+                ):
+                    counters = self._process_task(task)
             except Exception as exc:
                 logger.error(
                     "Task %d failed on rank %d:\n%s",
@@ -233,7 +261,8 @@ class CollectiveWorker:
                 )
                 if self._world.is_leader:
                     self._mc.report_task_result_best_effort(
-                        task.task_id, str(exc) or repr(exc)
+                        task.task_id, str(exc) or repr(exc),
+                        trace_id=task.trace_id,
                     )
                 # A failed collective step likely poisons the world: die and
                 # let the pod manager re-form it (reference: Horovod
@@ -247,7 +276,7 @@ class CollectiveWorker:
                 # retrains it.
                 if self._world.is_leader:
                     self._mc.report_task_result_best_effort(
-                        task.task_id, "", counters
+                        task.task_id, "", counters, trace_id=task.trace_id
                     )
         self._report_version(force=True)
         self._maybe_checkpoint(force=True)
@@ -456,6 +485,7 @@ class CollectiveWorker:
                 self._profiler.before_steps(
                     self._trainer.step, len(pending)
                 )
+            flush_start = time.monotonic()
             if len(pending) == window_steps and hasattr(
                 self._trainer, "stage_window"
             ):
@@ -467,6 +497,15 @@ class CollectiveWorker:
                     last_loss = self._trainer.train_step_staged(
                         self._trainer.stage_batch(*staged_batch)
                     )
+            if self._telemetry is not None:
+                # One telemetry sample per dispatch (not per step): the
+                # flush's mean step time + real records, feeding the
+                # heartbeat snapshot's percentiles and examples/s.
+                self._telemetry.record_steps(
+                    len(pending),
+                    time.monotonic() - flush_start,
+                    records=pending_real,
+                )
             batch_count += len(pending)
             record_count += pending_real
             pending, pending_real = [], 0

@@ -121,12 +121,19 @@ def _build_collective_worker(
 ):
     """Join the elastic world, build the mesh-wide trainer, restore state."""
     from elasticdl_tpu.checkpoint import CheckpointSaver
+    from elasticdl_tpu.obs.telemetry import WorkerTelemetry
     from elasticdl_tpu.parallel import MeshConfig, build_mesh
     from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
     from elasticdl_tpu.parallel.elastic import join_world
     from elasticdl_tpu.worker.collective_worker import CollectiveWorker
 
     world = join_world(client)
+    # Worker telemetry plane: step times / task progress / RPC retries
+    # collected here ride the liveness heartbeat to the master's
+    # aggregator (docs/observability.md "Worker telemetry plane").
+    telemetry = WorkerTelemetry(args.worker_id)
+    telemetry.bind_retry_stats(client.retry_stats)
+    telemetry.set_rendezvous(world.rendezvous_id)
     # All devices of the joined world, shaped (data, model): the model
     # axis carries sharded embedding tables and — for mesh-aware zoo
     # models — ring-attention context parallelism.
@@ -191,6 +198,7 @@ def _build_collective_worker(
             args.tensorboard_log_dir, args.profile_steps, args.worker_id
         ),
         train_window_steps=args.train_window_steps,
+        telemetry=telemetry,
     )
 
 

@@ -41,6 +41,7 @@ from elasticdl_tpu.common.grpc_utils import (
     RetryStats,
     build_channel,
     call_with_retry,
+    trace_metadata,
 )
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.proto.service import MasterStub
@@ -74,7 +75,7 @@ class MasterClient:
 
     # ------------------------------------------------------------------
 
-    def _call(self, method: str, request, policy: RetryPolicy):
+    def _call(self, method: str, request, policy: RetryPolicy, metadata=None):
         return call_with_retry(
             getattr(self._stub, method),
             request,
@@ -85,18 +86,20 @@ class MasterClient:
             # Per-worker jitter salt: deterministic per worker, but the
             # fleet's backoff schedules are decorrelated.
             seed=str(self._worker_id),
+            metadata=metadata,
         )
 
     def _call_idempotent(self, method: str, request):
         return self._call(method, request, self._retry_policy)
 
-    def _call_once(self, method: str, request, timeout_s: Optional[float] = None):
+    def _call_once(self, method: str, request, timeout_s: Optional[float] = None,
+                   metadata=None):
         policy = self._no_retry_policy
         if timeout_s is not None and timeout_s != policy.timeout_s:
             # Override only the deadline; an injected no_retry_policy
             # keeps its other fields.
             policy = dataclasses.replace(policy, timeout_s=timeout_s)
-        return self._call(method, request, policy)
+        return self._call(method, request, policy, metadata=metadata)
 
     # ------------------------------------------------------------------
 
@@ -105,19 +108,25 @@ class MasterClient:
         return self._call_idempotent("get_task", request).task
 
     def report_task_result(
-        self, task_id: int, err_message: str = "", exec_counters: Optional[Dict[str, int]] = None
+        self, task_id: int, err_message: str = "",
+        exec_counters: Optional[Dict[str, int]] = None, trace_id: str = "",
     ):
+        """`trace_id` (the dispatch-minted id from Task.trace_id) rides
+        gRPC metadata back to the master, closing the cross-process
+        journal chain (grpc_utils.TRACE_METADATA_KEY)."""
         request = pb.ReportTaskResultRequest(
             task_id=task_id, err_message=err_message, worker_id=self._worker_id
         )
         if exec_counters:
             for key, value in exec_counters.items():
                 request.exec_counters[key] = int(value)
-        self._call_once("report_task_result", request)
+        self._call_once(
+            "report_task_result", request, metadata=trace_metadata(trace_id)
+        )
 
     def report_task_result_best_effort(
         self, task_id: int, err_message: str = "",
-        exec_counters: Optional[Dict[str, int]] = None,
+        exec_counters: Optional[Dict[str, int]] = None, trace_id: str = "",
     ) -> bool:
         """Result report where delivery failure is data, not an error:
         result reports are non-idempotent and never retried, and an
@@ -125,7 +134,9 @@ class MasterClient:
         (at-least-once) — so a report lost to a master outage must not
         crash the worker or poison the world.  True when delivered."""
         try:
-            self.report_task_result(task_id, err_message, exec_counters)
+            self.report_task_result(
+                task_id, err_message, exec_counters, trace_id=trace_id
+            )
             return True
         except Exception:
             logger.warning(
@@ -172,11 +183,17 @@ class MasterClient:
             pb.GetCommRankRequest(worker_id=self._worker_id, host=host),
         )
 
-    def report_worker_liveness(self, host: str, rendezvous_id: int) -> bool:
+    def report_worker_liveness(
+        self, host: str, rendezvous_id: int, telemetry_json: str = ""
+    ) -> bool:
+        """`telemetry_json` is the worker's bounded telemetry snapshot
+        (obs/telemetry.py) — the heartbeat doubles as the telemetry
+        carrier, so per-worker observability costs zero new RPCs."""
         response = self._call_idempotent(
             "report_worker_liveness",
             pb.ReportWorkerLivenessRequest(
-                worker_id=self._worker_id, host=host, rendezvous_id=rendezvous_id
+                worker_id=self._worker_id, host=host,
+                rendezvous_id=rendezvous_id, telemetry_json=telemetry_json,
             ),
         )
         return response.should_reset

@@ -104,7 +104,8 @@ class Worker:
             except Exception as exc:
                 logger.error("Task %d failed:\n%s", task.task_id, traceback.format_exc())
                 self._mc.report_task_result_best_effort(
-                    task.task_id, str(exc) or repr(exc)
+                    task.task_id, str(exc) or repr(exc),
+                    trace_id=task.trace_id,
                 )
                 consecutive_failures += 1
                 if consecutive_failures >= self._max_consecutive_failures:
@@ -118,7 +119,7 @@ class Worker:
                 # already-trained records AND double-charge the task's
                 # retry budget).
                 self._mc.report_task_result_best_effort(
-                    task.task_id, "", counters
+                    task.task_id, "", counters, trace_id=task.trace_id
                 )
                 consecutive_failures = 0
         # Final version report so master-side services see the last step.
@@ -132,9 +133,13 @@ class Worker:
         except ValueError:
             type_name = "UNKNOWN"
         # Span: per-task worker-side latency histogram (bounded `type`
-        # label) + a journal record carrying the unbounded task id.
+        # label) + a journal record carrying the unbounded task id and the
+        # dispatch-minted trace id (the worker half of the trace chain).
+        span_fields = dict(task_id=task.task_id)
+        if task.trace_id:
+            span_fields["trace_id"] = task.trace_id
         with obs.span(
-            "worker.task", labels={"type": type_name}, task_id=task.task_id
+            "worker.task", labels={"type": type_name}, **span_fields
         ):
             if task.type == pb.TRAINING:
                 return self._process_train_task(task)

@@ -6,6 +6,13 @@
 # build dependency).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if ! command -v protoc >/dev/null 2>&1; then
+    # No protoc on this box (the CI/dev container ships only the protobuf
+    # runtime): additive schema changes go through the descriptor-patching
+    # fallback instead.
+    echo "protoc not found; falling back to scripts/regen_proto.py" >&2
+    exec python scripts/regen_proto.py
+fi
 protoc --proto_path=elasticdl_tpu/proto \
        --python_out=elasticdl_tpu/proto \
        elasticdl_tpu/proto/elasticdl.proto

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Schema-check a control-plane event journal (JSONL).
+
+    python scripts/validate_journal.py /logs/job1/events.jsonl [...]
+    python scripts/validate_journal.py --selftest
+
+Exit status: 0 when every record validates, 1 on any malformed record,
+2 on usage errors.  Wired into ``make test-obs`` (via --selftest plus
+the subprocess tests in tests/test_telemetry.py) so the journal the
+tooling (obs.top, chaos-test reconstruction, post-mortem grep) depends
+on can't silently drift from the documented schema
+(docs/observability.md "Event journal").
+
+Every record must be a JSON object with a numeric ``ts`` and a
+non-empty string ``event``; events named in ``EVENT_REQUIRED_FIELDS``
+must additionally carry their listed fields.  Unknown event types pass
+(the journal is open for extension) — malformed JSON, wrong-typed
+envelope fields, or missing required fields fail.  Stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import List, Tuple
+
+#: Required fields per documented event type (docs/observability.md).
+#: Extension stays cheap: add the event name + its load-bearing fields.
+EVENT_REQUIRED_FIELDS = {
+    "master_start": ("job_name",),
+    "rendezvous": ("rendezvous_id", "world_size"),
+    "task_dispatch": ("task_id", "worker_id", "trace_id"),
+    "task_done": ("task_id", "trace_id"),
+    "task_requeue": ("reason",),
+    "task_failed_permanently": ("task_id",),
+    "worker_churn": ("workers", "exit_codes"),
+    "hung_worker_kill": ("worker_id",),
+    "worker_telemetry": ("worker_id",),
+    "straggler_detected": ("worker_id", "metric"),
+    "straggler_cleared": ("worker_id",),
+    "scale": ("old_size", "new_size"),
+    "scale_up": ("old_size", "new_size"),
+    "span": ("name", "duration_s"),
+    "job_failed": ("reason",),
+}
+
+
+def validate_record(record: object) -> List[str]:
+    """Schema errors for one parsed record ([] when valid)."""
+    errors = []
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, not an object"]
+    ts = record.get("ts")
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+        errors.append(f"'ts' must be a number, got {ts!r}")
+    event = record.get("event")
+    if not isinstance(event, str) or not event:
+        errors.append(f"'event' must be a non-empty string, got {event!r}")
+        return errors
+    for field in EVENT_REQUIRED_FIELDS.get(event, ()):
+        if field not in record:
+            errors.append(f"event '{event}' missing required field '{field}'")
+    return errors
+
+
+def validate_file(path: str) -> List[Tuple[int, str]]:
+    """(line number, message) for every invalid line in a journal file."""
+    problems: List[Tuple[int, str]] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError as exc:
+                problems.append((lineno, f"invalid JSON: {exc}"))
+                continue
+            for message in validate_record(record):
+                problems.append((lineno, message))
+    return problems
+
+
+def _selftest() -> int:
+    """Generate a known-good and a known-bad journal and verify this
+    validator tells them apart — the `make test-obs` sanity gate."""
+    good = [
+        {"ts": 1.0, "event": "master_start", "job_name": "j", "port": 1},
+        {"ts": 2.0, "event": "rendezvous", "rendezvous_id": 1,
+         "world_size": 2, "workers": [0, 1]},
+        {"ts": 3.0, "event": "task_dispatch", "task_id": 1, "worker_id": 0,
+         "trace_id": "t-1-1"},
+        {"ts": 4.0, "event": "worker_telemetry", "worker_id": 0,
+         "step_p50_s": 0.01},
+        {"ts": 5.0, "event": "straggler_detected", "worker_id": 1,
+         "metric": "step_time", "value": 1.0},
+        {"ts": 6.0, "event": "task_done", "task_id": 1, "trace_id": "t-1-1"},
+        {"ts": 7.0, "event": "some_future_event", "anything": "goes"},
+    ]
+    bad_lines = [
+        '{"ts": 1.0, "event": "task_requeue"}',        # missing reason
+        '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts
+        '{"ts": "yesterday", "event": "span", "name": "x", "duration_s": 1}',
+        '{"ts": 2.0}',                                  # no event
+        '{"ts": 3.0, "event": "task_done", "task_id"',  # truncated JSON
+        '[1, 2, 3]',                                    # not an object
+    ]
+    with tempfile.TemporaryDirectory(prefix="journal_selftest_") as tmp:
+        good_path = os.path.join(tmp, "good.jsonl")
+        with open(good_path, "w", encoding="utf-8") as f:
+            for record in good:
+                f.write(json.dumps(record) + "\n")
+        bad_path = os.path.join(tmp, "bad.jsonl")
+        with open(bad_path, "w", encoding="utf-8") as f:
+            f.write("\n".join(bad_lines) + "\n")
+        good_problems = validate_file(good_path)
+        bad_problems = validate_file(bad_path)
+    if good_problems:
+        print("selftest FAILED: valid journal flagged:", file=sys.stderr)
+        for lineno, message in good_problems:
+            print(f"  line {lineno}: {message}", file=sys.stderr)
+        return 1
+    if len({lineno for lineno, _ in bad_problems}) != len(bad_lines):
+        print(
+            f"selftest FAILED: expected every one of {len(bad_lines)} bad "
+            f"lines flagged, got {bad_problems}",
+            file=sys.stderr,
+        )
+        return 1
+    print("validate_journal selftest OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Schema-check control-plane event journals (JSONL).",
+    )
+    parser.add_argument("paths", nargs="*", help="journal files to check")
+    parser.add_argument(
+        "--quiet", action="store_true", help="suppress per-line messages"
+    )
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="validate a generated good/bad pair and exit",
+    )
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        return 2
+    failed = False
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"{path}: no such file", file=sys.stderr)
+            failed = True
+            continue
+        problems = validate_file(path)
+        if problems:
+            failed = True
+            if not args.quiet:
+                for lineno, message in problems:
+                    print(f"{path}:{lineno}: {message}", file=sys.stderr)
+            print(
+                f"{path}: {len(problems)} problem(s)", file=sys.stderr
+            )
+        else:
+            print(f"{path}: OK")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

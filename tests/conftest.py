@@ -24,6 +24,31 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import pytest
+
+
+@pytest.fixture
+def obs_registry_snapshot():
+    """Save/restore the process-wide obs registry around a test so
+    metrics registered inside it (telemetry aggregators, ad-hoc gauges)
+    can't leak into another test's scrape.  RESTORE, not reset(): metric
+    objects bound at import time (the RPC retry counters in
+    common/grpc_utils) must keep their registry membership — clearing
+    would orphan them for the rest of the session.  For the same reason,
+    every import-time registrant is imported BEFORE the snapshot: if the
+    test itself triggered that first import, restore would silently
+    unregister the freshly-bound module constants.  Yields the registry.
+    """
+    import elasticdl_tpu.common.grpc_utils  # noqa: F401 — import-time metrics
+    from elasticdl_tpu import obs
+
+    registry = obs.registry()
+    saved = registry.snapshot()
+    try:
+        yield registry
+    finally:
+        registry.restore(saved)
+
 
 def run_kill_recovery_job(
     args, n_records, worker_env, log_dir, progress_fraction=8,

@@ -92,8 +92,11 @@ class RecordingClient(MasterClient):
             self._inflight[task.task_id] = (task.epoch, task.start, task.end)
         return task
 
-    def report_task_result(self, task_id, err_message="", exec_counters=None):
-        super().report_task_result(task_id, err_message, exec_counters)
+    def report_task_result(self, task_id, err_message="", exec_counters=None,
+                           trace_id=""):
+        super().report_task_result(
+            task_id, err_message, exec_counters, trace_id=trace_id
+        )
         if not err_message and task_id in self._inflight:
             self.completed.append(self._inflight.pop(task_id))
 

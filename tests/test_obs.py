@@ -259,6 +259,52 @@ def _get(url, timeout=10):
         return response.status, response.read().decode()
 
 
+def _head(url, timeout=10):
+    request = urllib.request.Request(url, method="HEAD")
+    with urllib.request.urlopen(request, timeout=timeout) as response:
+        return response.status, response.headers, response.read()
+
+
+def test_exporter_journal_endpoint_and_head(tmp_path):
+    """Satellite: /journal serves the bounded event tail as JSON with no
+    file-path leakage, and every endpoint answers HEAD without a body."""
+    registry = MetricsRegistry()
+    registry.counter("head_demo_total", "help").inc()
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    for i in range(10):
+        journal.record("evt", i=i)
+    exporter = MetricsExporter(
+        registry=registry, journal=journal, port=0
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        status, body = _get(base + "/journal")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["count"] == 10
+        assert [e["i"] for e in payload["events"]] == list(range(10))
+        # No journal file path anywhere in the response (the endpoint may
+        # be exposed beyond the master host).
+        assert "events.jsonl" not in body
+        # ?n= bounds the tail; nonsense values fall back to the default.
+        status, body = _get(base + "/journal?n=3")
+        assert [e["i"] for e in json.loads(body)["events"]] == [7, 8, 9]
+        status, body = _get(base + "/journal?n=bogus")
+        assert json.loads(body)["count"] == 10
+        # HEAD: headers (incl. a real Content-Length) but no body.
+        for path in ("/metrics", "/healthz", "/journal", "/debug/vars"):
+            status, headers, head_body = _head(base + path)
+            assert status == 200, path
+            assert head_body == b"", path
+            assert int(headers["Content-Length"]) > 0, path
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _head(base + "/nope")
+        assert err.value.code == 404
+    finally:
+        exporter.stop()
+        journal.close()
+
+
 def test_exporter_roundtrip_metrics_healthz_debug_vars(tmp_path):
     registry = MetricsRegistry()
     journal = EventJournal(str(tmp_path / "events.jsonl"))

@@ -1,0 +1,790 @@
+"""Worker telemetry plane tests (obs/telemetry.py + the tentpole wiring).
+
+Covers:
+
+- WorkerTelemetry snapshot schema, bounded size, and percentile math;
+- StragglerDetector: median + k*MAD threshold, floors, hysteresis,
+  min-workers guard, fleet-departure cleanup;
+- TelemetryAggregator: ingest -> fleet gauges (per-worker values are
+  journal-only), malformed payloads, rate-limited worker_telemetry
+  journal events, straggler transitions (journal + gauge + advisory
+  callback), current-world scoping;
+- HeartbeatReporter jitter satellite (deterministic, decorrelated,
+  bounded);
+- obs.top parsing/rendering and a live frame against a real exporter;
+- scripts/validate_journal.py over a real journal (subprocess);
+- the metric-label-cardinality analysis rule over the new telemetry
+  call sites (worker_id must never become a metric label);
+- the ISSUE acceptance end-to-end: a local master + three heartbeating
+  workers over real gRPC — an artificially slowed worker is flagged as
+  a straggler within a bounded number of heartbeats, clears when the
+  slowdown is removed, and a completed task's trace id links dispatch,
+  worker span, and completion records across the journal.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.obs.telemetry import (
+    SNAPSHOT_VERSION,
+    StragglerDetector,
+    TelemetryAggregator,
+    WorkerTelemetry,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# WorkerTelemetry
+# ---------------------------------------------------------------------------
+
+
+def test_worker_telemetry_snapshot_schema():
+    telemetry = WorkerTelemetry(worker_id=7)
+    telemetry.set_rendezvous(3)
+    telemetry.begin_task(42, "TRAINING", records_total=1000)
+    for _ in range(10):
+        telemetry.record_steps(4, duration_s=0.04, records=100)  # 10ms/step
+    snap = telemetry.snapshot()
+    assert snap["v"] == SNAPSHOT_VERSION
+    assert snap["worker_id"] == 7
+    assert snap["rendezvous_id"] == 3
+    assert snap["steps_total"] == 40
+    assert snap["records_total"] == 1000
+    assert snap["task"] == {
+        "id": 42, "type": "TRAINING",
+        "records_done": 1000, "records_total": 1000,
+    }
+    assert snap["step_p50_s"] == pytest.approx(0.01)
+    assert snap["step_p95_s"] == pytest.approx(0.01)
+    assert snap["examples_per_s"] > 0
+    assert "ts" in snap
+
+    class _Stats:
+        retries = 5
+        give_ups = 1
+
+    telemetry.bind_retry_stats(_Stats())
+    snap = telemetry.snapshot()
+    assert snap["rpc"] == {"retries": 5, "give_ups": 1}
+    # The wire form parses back and stays bounded.
+    payload = telemetry.snapshot_json()
+    assert json.loads(payload) == snap
+    assert len(payload.encode()) < 4096
+
+
+def test_worker_telemetry_percentiles_track_recent_regime():
+    telemetry = WorkerTelemetry(worker_id=0, step_window=4)
+    for _ in range(4):
+        telemetry.record_steps(1, duration_s=1.0)  # slow regime
+    assert telemetry.snapshot()["step_p50_s"] == pytest.approx(1.0)
+    for _ in range(4):
+        telemetry.record_steps(1, duration_s=0.01)  # recovered
+    assert telemetry.snapshot()["step_p50_s"] == pytest.approx(0.01)
+
+
+def test_worker_telemetry_oversized_snapshot_degrades():
+    telemetry = WorkerTelemetry(worker_id=1)
+    # begin_task truncates the type, so build the bloat via a monkeyed
+    # field: simulate by injecting an oversized task type directly.
+    telemetry.begin_task(1, "x" * 10000, records_total=1)
+    snap = telemetry.snapshot()
+    assert len(snap["task"]["type"]) == 32  # truncated at ingest
+    assert len(telemetry.snapshot_json().encode()) < 4096
+
+
+def test_worker_telemetry_ignores_empty_flushes():
+    telemetry = WorkerTelemetry(worker_id=2)
+    telemetry.record_steps(0, duration_s=1.0)
+    assert "step_p50_s" not in telemetry.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# StragglerDetector
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_detector_flags_after_hysteresis_and_clears():
+    detector = StragglerDetector(flag_after=2, clear_after=2)
+    fleet = {0: 0.010, 1: 0.012, 2: 0.011, 3: 0.200}
+    stale = {wid: 0.1 for wid in fleet}
+    assert detector.evaluate(fleet, stale) == []  # streak 1: no flag yet
+    transitions = detector.evaluate(fleet, stale)  # streak 2: flagged
+    assert [(t["worker_id"], t["flagged"]) for t in transitions] == [(3, True)]
+    assert transitions[0]["metric"] == "step_time"
+    assert transitions[0]["value"] > transitions[0]["threshold"]
+    assert 3 in detector.flagged
+    # Recovery: under threshold for clear_after evaluations.
+    fleet[3] = 0.011
+    assert detector.evaluate(fleet, stale) == []
+    transitions = detector.evaluate(fleet, stale)
+    assert [(t["worker_id"], t["flagged"]) for t in transitions] == [(3, False)]
+    assert detector.flagged == {}
+
+
+def test_straggler_detector_floors_protect_tight_fleets():
+    """A healthy homogeneous fleet (MAD ~ 0) must not flag micro-jitter:
+    the rel_floor keeps the threshold a fraction above the median."""
+    detector = StragglerDetector(flag_after=1)
+    fleet = {0: 0.0100, 1: 0.0101, 2: 0.0099, 3: 0.0104}
+    stale = {wid: 0.1 for wid in fleet}
+    assert detector.evaluate(fleet, stale) == []
+    assert detector.flagged == {}
+
+
+def test_straggler_detector_min_workers_guard():
+    detector = StragglerDetector(flag_after=1, min_workers=3)
+    assert detector.evaluate({0: 0.01, 1: 9.0}, {0: 0.1, 1: 0.1}) == []
+    assert detector.flagged == {}
+
+
+def test_straggler_detector_staleness_signal():
+    detector = StragglerDetector(flag_after=1)
+    fleet = {0: 0.01, 1: 0.01, 2: 0.01}
+    stale = {0: 0.1, 1: 0.1, 2: 60.0}
+    transitions = detector.evaluate(fleet, stale)
+    assert [(t["worker_id"], t["metric"]) for t in transitions] == [
+        (2, "staleness")
+    ]
+
+
+def test_straggler_detector_departed_worker_drops_silently():
+    detector = StragglerDetector(flag_after=1)
+    fleet = {0: 0.01, 1: 0.01, 2: 5.0}
+    stale = {wid: 0.1 for wid in fleet}
+    assert detector.evaluate(fleet, stale)  # 2 flagged
+    # Worker 2 leaves the world (rescale): no straggler_cleared noise,
+    # its state just evaporates.
+    del fleet[2], stale[2]
+    fleet[3] = 0.01
+    stale[3] = 0.1
+    assert detector.evaluate(fleet, stale) == []
+    assert detector.flagged == {}
+
+
+# ---------------------------------------------------------------------------
+# TelemetryAggregator
+# ---------------------------------------------------------------------------
+
+
+def _snap(worker_id, p50=None, examples=0.0, **extra):
+    snap = {
+        "v": SNAPSHOT_VERSION,
+        "worker_id": worker_id,
+        "ts": time.time(),
+        "examples_per_s": examples,
+        **extra,
+    }
+    if p50 is not None:
+        snap["step_p50_s"] = p50
+        snap["step_p95_s"] = p50 * 1.5
+    return json.dumps(snap)
+
+
+def test_aggregator_folds_fleet_gauges(obs_registry_snapshot):
+    clock = {"t": 100.0}
+    aggregator = TelemetryAggregator(
+        current_workers_fn=lambda: [0, 1, 2],
+        clock=lambda: clock["t"],
+        journal_interval_s=1e9,  # journaling exercised separately
+    )
+    aggregator.ingest(0, _snap(0, p50=0.010, examples=100.0))
+    clock["t"] = 101.0
+    aggregator.ingest(1, _snap(1, p50=0.012, examples=80.0))
+    aggregator.ingest(2, _snap(2, p50=0.020, examples=50.0))
+    registry = obs.registry()
+    assert registry.get(
+        "elasticdl_worker_step_time_p50_seconds"
+    ).value() == pytest.approx(0.012)
+    assert registry.get(
+        "elasticdl_worker_step_time_p95_seconds"
+    ).value() == pytest.approx(0.030)
+    assert registry.get(
+        "elasticdl_worker_examples_per_second_min"
+    ).value() == pytest.approx(50.0)
+    assert registry.get(
+        "elasticdl_worker_examples_per_second_max"
+    ).value() == pytest.approx(100.0)
+    assert registry.get("elasticdl_telemetry_workers").value() == 3
+    # Staleness: worker 0 reported at t=100, clock now 101.
+    assert registry.get(
+        "elasticdl_telemetry_staleness_seconds"
+    ).value() == pytest.approx(1.0)
+    # Reports from workers OUTSIDE the current world are excluded.
+    aggregator.ingest(99, _snap(99, p50=9.0))
+    assert registry.get("elasticdl_telemetry_workers").value() == 3
+    assert registry.get(
+        "elasticdl_worker_step_time_p95_seconds"
+    ).value() == pytest.approx(0.030)
+    assert 99 not in aggregator.worker_snapshots()
+
+
+def test_aggregator_rejects_malformed_payloads(obs_registry_snapshot):
+    aggregator = TelemetryAggregator(journal_interval_s=1e9)
+    malformed = obs.registry().get("elasticdl_telemetry_malformed_total")
+    base = malformed.value()
+    aggregator.ingest(0, "not json at all {{{")
+    aggregator.ingest(0, json.dumps(["a", "list"]))
+    aggregator.ingest(0, json.dumps({"v": 999, "worker_id": 0}))
+    # v=1 but wrong-typed fields: strings/bools where numbers belong
+    # would poison gauge arithmetic — rejected, never cached.
+    aggregator.ingest(0, json.dumps({"v": 1, "step_p50_s": "abc"}))
+    aggregator.ingest(0, json.dumps({"v": 1, "examples_per_s": True}))
+    aggregator.ingest(0, json.dumps({"v": 1, "task": {"id": "seven"}}))
+    assert malformed.value() == base + 6
+    assert aggregator.worker_snapshots() == {}
+
+
+def test_ingest_is_exception_proof_and_scrape_safe(obs_registry_snapshot):
+    """A hostile-but-v1 payload must neither raise out of ingest (it
+    rides the liveness RPC) nor break subsequent /metrics scrapes or
+    other workers' ingests."""
+    aggregator = TelemetryAggregator(
+        current_workers_fn=lambda: [0, 1], journal_interval_s=0.0
+    )
+    # Unknown keys — including an `event` key that would collide with
+    # the journal-record envelope — are dropped, not forwarded.
+    marker = time.time() - 1
+    aggregator.ingest(
+        0,
+        json.dumps({"v": 1, "worker_id": 0, "step_p50_s": 0.01,
+                    "event": "spoofed", "surprise": {"deep": "junk"}}),
+    )
+    events = [
+        e for e in obs.journal().tail(50)
+        if e.get("worker_id") == 0 and e["ts"] >= marker
+    ]
+    assert events and events[-1]["event"] == "worker_telemetry"
+    assert "surprise" not in events[-1]
+    aggregator.ingest(0, json.dumps({"v": 1, "step_p95_s": "NaN-ish"}))
+    aggregator.ingest(1, _snap(1, p50=0.02))  # other workers unaffected
+    assert sorted(aggregator.worker_snapshots()) == [0, 1]
+    # The scrape still renders (sanitized values are all numeric).
+    assert "elasticdl_worker_step_time_p50_seconds" in (
+        obs.registry().render_prometheus()
+    )
+
+
+def test_worker_clock_skew_cannot_reorder_the_journal(obs_registry_snapshot):
+    """The snapshot's own `ts` (worker wall clock, possibly skewed hours)
+    forwards as `worker_ts`; the journal envelope keeps the MASTER's
+    write time so the timeline stays sorted."""
+    aggregator = TelemetryAggregator(journal_interval_s=0.0)
+    before = time.time()
+    aggregator.ingest(
+        3, json.dumps({"v": 1, "worker_id": 3, "ts": 12345.0,
+                       "step_p50_s": 0.01})
+    )
+    event = [
+        e for e in obs.journal().tail(50)
+        if e["event"] == "worker_telemetry" and e.get("worker_id") == 3
+    ][-1]
+    assert event["worker_ts"] == 12345.0
+    assert event["ts"] >= before - 1  # master write time, not 1970+12345s
+
+
+def test_aggregator_journals_worker_detail_rate_limited(obs_registry_snapshot):
+    clock = {"t": 50.0}
+    aggregator = TelemetryAggregator(
+        clock=lambda: clock["t"], journal_interval_s=10.0
+    )
+    marker = time.time() - 1
+    aggregator.ingest(5, _snap(5, p50=0.01, task={"id": 3}))
+    clock["t"] = 51.0
+    aggregator.ingest(5, _snap(5, p50=0.01))  # inside the interval: no event
+    clock["t"] = 61.0
+    aggregator.ingest(5, _snap(5, p50=0.02))  # interval elapsed: journaled
+    events = [
+        e for e in obs.journal().tail(100)
+        if e["event"] == "worker_telemetry" and e.get("worker_id") == 5
+        and e["ts"] >= marker
+    ]
+    assert len(events) == 2
+    # Per-worker detail rides the JOURNAL (cardinality rule) and keeps
+    # its snapshot fields.
+    assert events[0]["task"] == {"id": 3}
+    assert events[1]["step_p50_s"] == pytest.approx(0.02)
+
+
+def test_aggregator_straggler_transitions(obs_registry_snapshot):
+    clock = {"t": 10.0}
+    aggregator = TelemetryAggregator(
+        detector=StragglerDetector(flag_after=2, clear_after=2),
+        clock=lambda: clock["t"],
+        journal_interval_s=1e9,
+    )
+    advisories = []
+    aggregator.add_straggler_callback(
+        lambda wid, flagged, evidence: advisories.append((wid, flagged))
+    )
+    marker = time.time() - 1
+    aggregator.ingest(0, _snap(0, p50=0.010))
+    aggregator.ingest(1, _snap(1, p50=0.011))
+    for _ in range(3):
+        clock["t"] += 0.1
+        aggregator.ingest(2, _snap(2, p50=0.500))
+    stragglers_gauge = obs.registry().get("elasticdl_stragglers")
+    assert stragglers_gauge.value() == 1
+    assert list(aggregator.stragglers()) == [2]
+    assert advisories == [(2, True)]
+    detected = [
+        e for e in obs.journal().tail(100)
+        if e["event"] == "straggler_detected" and e["ts"] >= marker
+    ]
+    assert len(detected) == 1
+    assert detected[0]["worker_id"] == 2
+    assert detected[0]["metric"] == "step_time"
+    assert detected[0]["value"] > detected[0]["threshold"]
+    # Recovery clears with hysteresis.
+    for _ in range(3):
+        clock["t"] += 0.1
+        aggregator.ingest(2, _snap(2, p50=0.011))
+    assert stragglers_gauge.value() == 0
+    assert advisories == [(2, True), (2, False)]
+    cleared = [
+        e for e in obs.journal().tail(100)
+        if e["event"] == "straggler_cleared" and e["ts"] >= marker
+    ]
+    assert len(cleared) == 1 and cleared[0]["worker_id"] == 2
+
+
+def test_one_noisy_sample_does_not_flag(obs_registry_snapshot):
+    """Hysteresis counts FRESH samples from the candidate worker, not
+    detector evaluations: other workers' heartbeats re-judging the same
+    stale outlier must not burn through flag_after."""
+    aggregator = TelemetryAggregator(
+        detector=StragglerDetector(flag_after=2, clear_after=2),
+        journal_interval_s=1e9,
+    )
+    for wid in range(4):
+        aggregator.ingest(wid, _snap(wid, p50=0.01))
+    # One outlier snapshot from worker 4 (a GC pause), then a storm of
+    # other workers' heartbeats over the SAME stale sample.
+    aggregator.ingest(4, _snap(4, p50=5.0))
+    for _ in range(10):
+        for wid in range(4):
+            aggregator.ingest(wid, _snap(wid, p50=0.01))
+    assert aggregator.stragglers() == {}
+    # A SECOND slow sample from the worker itself does flag.
+    aggregator.ingest(4, _snap(4, p50=5.0))
+    assert list(aggregator.stragglers()) == [4]
+
+
+def test_slow_then_silent_worker_flags_via_staleness(obs_registry_snapshot):
+    """A worker that was over the step-time threshold and then goes
+    SILENT must still flag: its frozen step evidence yields to staleness
+    (which grows on every pass) — the most suspicious worker kind must
+    not be the one the detector misses."""
+    clock = {"t": 0.0}
+    aggregator = TelemetryAggregator(
+        detector=StragglerDetector(flag_after=2, clear_after=2),
+        clock=lambda: clock["t"],
+        journal_interval_s=1e9,
+    )
+    for wid, p50 in ((0, 0.010), (1, 0.011), (2, 0.012), (3, 0.500)):
+        aggregator.ingest(wid, _snap(wid, p50=p50))
+    # Worker 3 stops reporting entirely; the healthy fleet keeps beating.
+    for beat in range(5):
+        clock["t"] += 30.0
+        for wid in range(3):
+            aggregator.ingest(wid, _snap(wid, p50=0.011))
+    assert list(aggregator.stragglers()) == [3]
+    assert aggregator.stragglers()[3]["metric"] == "staleness"
+
+
+def test_aggregator_prunes_departed_worker_reports(obs_registry_snapshot):
+    """_reports must not leak across world re-formations: worker ids
+    grow monotonically, so unpruned entries accumulate for the life of
+    the master."""
+    world = {"ids": [0, 1]}
+    aggregator = TelemetryAggregator(
+        current_workers_fn=lambda: world["ids"], journal_interval_s=1e9
+    )
+    aggregator.ingest(0, _snap(0, p50=0.01))
+    aggregator.ingest(1, _snap(1, p50=0.01))
+    world["ids"] = [2, 3]  # restart-the-world: fresh ids
+    aggregator.ingest(2, _snap(2, p50=0.01))
+    assert sorted(aggregator._reports) == [2]
+    # A torn-down world's straggler reporting in is dropped, not cached.
+    aggregator.ingest(0, _snap(0, p50=0.01))
+    assert sorted(aggregator._reports) == [2]
+
+
+def test_pod_manager_consumes_straggler_advisories(obs_registry_snapshot):
+    from elasticdl_tpu.master.pod_manager import LocalProcessManager
+
+    manager = LocalProcessManager(
+        num_workers=1, worker_argv_fn=lambda wid: ["true"]
+    )
+    counter = obs.registry().get("elasticdl_straggler_advisories_total")
+    base = counter.value()
+    manager.note_straggler(4, True, {"metric": "step_time"})
+    assert manager.current_straggler_ids() == [4]
+    assert counter.value() == base + 1
+    manager.note_straggler(4, False)
+    assert manager.current_straggler_ids() == []
+
+
+def test_pod_manager_advisories_die_with_the_world(obs_registry_snapshot):
+    """A flagged worker that churns must not haunt the advisory set:
+    worker ids are never reused, so world launch prunes flags for ids
+    outside the new world."""
+    from elasticdl_tpu.master.pod_manager import LocalProcessManager
+
+    manager = LocalProcessManager(
+        num_workers=2, worker_argv_fn=lambda wid: ["true"]
+    )
+    manager.note_straggler(5, True)
+    assert manager.current_straggler_ids() == [5]
+    try:
+        manager._launch_world(2)  # ids 0,1 — worker 5 is gone
+        assert manager.current_straggler_ids() == []
+    finally:
+        manager.stop()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat jitter satellite
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_interval_jitter_bounded_and_decorrelated():
+    from elasticdl_tpu.parallel.elastic import HeartbeatReporter, WorldInfo
+
+    world = WorldInfo(rank=0, world_size=2, rendezvous_id=1,
+                      coordinator_addr="")
+
+    class _Client:
+        def __init__(self, worker_id):
+            self.worker_id = worker_id
+
+    r0 = HeartbeatReporter(_Client(0), world, host="h", interval_s=5.0)
+    r1 = HeartbeatReporter(_Client(1), world, host="h", interval_s=5.0)
+    s0 = [r0.jittered_interval_s(t) for t in range(64)]
+    s1 = [r1.jittered_interval_s(t) for t in range(64)]
+    assert all(4.0 <= v <= 6.0 for v in s0 + s1)  # +/-20% of 5s
+    assert len(set(round(v, 9) for v in s0)) > 32  # varies tick to tick
+    assert s0 != s1  # decorrelated across workers
+    assert s0 == [r0.jittered_interval_s(t) for t in range(64)]  # deterministic
+    plain = HeartbeatReporter(
+        _Client(0), world, host="h", interval_s=5.0, jitter=0.0
+    )
+    assert plain.jittered_interval_s(0) == 5.0
+
+
+# ---------------------------------------------------------------------------
+# obs.top
+# ---------------------------------------------------------------------------
+
+
+def test_top_worker_rows_and_render():
+    from elasticdl_tpu.obs import top
+
+    now = 1000.0
+    events = [
+        {"ts": now - 30, "event": "worker_telemetry", "worker_id": 0,
+         "step_p50_s": 0.01, "step_p95_s": 0.02, "examples_per_s": 500.0,
+         "task": {"id": 7, "records_done": 10, "records_total": 64},
+         "rendezvous_id": 2, "rpc": {"retries": 1}},
+        {"ts": now - 20, "event": "straggler_detected", "worker_id": 1,
+         "metric": "step_time", "value": 1.0},
+        {"ts": now - 10, "event": "worker_telemetry", "worker_id": 1,
+         "step_p50_s": 1.0, "examples_per_s": 5.0, "rendezvous_id": 2},
+        {"ts": now - 5, "event": "worker_telemetry", "worker_id": 0,
+         "step_p50_s": 0.011, "step_p95_s": 0.021, "examples_per_s": 480.0,
+         "task": {"id": 9, "records_done": 32, "records_total": 64},
+         "rendezvous_id": 2, "rpc": {"retries": 1}},
+    ]
+    rows = top.worker_rows(events, now=now)
+    assert [r["worker"] for r in rows] == [0, 1]
+    assert rows[0]["task"] == 9  # latest snapshot wins
+    assert rows[0]["progress"] == "32/64"
+    assert rows[0]["state"] == "ok"
+    assert rows[1]["state"] == "STRAGGLER(step_time)"
+    assert rows[1]["p95_ms"] == "-"  # missing field renders as a dash
+    metrics = top.parse_metrics(
+        "# HELP elasticdl_world_size x\n"
+        "elasticdl_world_size 2\n"
+        "elasticdl_stragglers 1\n"
+        'labeled_total{a="b"} 3\n'
+    )
+    assert metrics == {"elasticdl_world_size": 2.0, "elasticdl_stragglers": 1.0}
+    frame = top.render(rows, metrics, addr="localhost:9090")
+    assert "world=2" in frame and "stragglers=1" in frame
+    assert "STRAGGLER(step_time)" in frame
+    # Cleared stragglers drop the marker.
+    events.append(
+        {"ts": now, "event": "straggler_cleared", "worker_id": 1}
+    )
+    rows = top.worker_rows(events, now=now)
+    assert rows[1]["state"] == "ok"
+
+
+def test_top_render_without_workers():
+    from elasticdl_tpu.obs import top
+
+    frame = top.render([], {}, addr="x:1")
+    assert "no worker_telemetry events" in frame
+
+
+# ---------------------------------------------------------------------------
+# validate_journal.py over a real journal
+# ---------------------------------------------------------------------------
+
+
+def _run_validator(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "validate_journal.py"),
+         *argv],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_validate_journal_accepts_real_journal(tmp_path):
+    from elasticdl_tpu.obs.journal import EventJournal
+
+    path = tmp_path / "events.jsonl"
+    journal = EventJournal(str(path))
+    journal.record("master_start", job_name="j", port=1)
+    journal.record("rendezvous", rendezvous_id=1, world_size=2, workers=[0, 1])
+    journal.record("task_dispatch", task_id=1, worker_id=0, trace_id="t-a-1")
+    journal.record("worker_telemetry", worker_id=0, step_p50_s=0.01)
+    journal.record("straggler_detected", worker_id=1, metric="step_time")
+    journal.record("straggler_cleared", worker_id=1)
+    journal.record("task_done", task_id=1, trace_id="t-a-1", duration_s=0.5)
+    journal.close()
+    result = _run_validator(str(path))
+    assert result.returncode == 0, result.stderr
+
+
+def test_validate_journal_rejects_malformed(tmp_path):
+    path = tmp_path / "broken.jsonl"
+    path.write_text(
+        '{"ts": 1.0, "event": "task_requeue"}\n'   # missing reason
+        'not json\n'
+    )
+    result = _run_validator(str(path))
+    assert result.returncode == 1
+    assert "missing required field 'reason'" in result.stderr
+    assert "invalid JSON" in result.stderr
+
+
+def test_validate_journal_selftest():
+    result = _run_validator("--selftest")
+    assert result.returncode == 0, result.stderr
+
+
+# ---------------------------------------------------------------------------
+# metric-label-cardinality over the new telemetry call sites
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_call_sites_pass_cardinality_rule():
+    """Satellite: the telemetry plane's metric call sites keep worker ids
+    out of metric labels (journal-only), and the rule still bites on a
+    seeded violation — proving the clean pass is not vacuous."""
+    from elasticdl_tpu.analysis.core import SourceFile, run_checks
+    from elasticdl_tpu.analysis.rules import check_metric_label_cardinality
+
+    new_call_sites = [
+        os.path.join(REPO_ROOT, "elasticdl_tpu", rel)
+        for rel in (
+            "obs/telemetry.py",
+            "obs/top.py",
+            "master/servicer.py",
+            "master/pod_manager.py",
+            "parallel/elastic.py",
+        )
+    ]
+    violations = run_checks(new_call_sites, [check_metric_label_cardinality])
+    assert violations == [], "\n".join(v.format() for v in violations)
+    seeded = SourceFile.parse(
+        "seeded.py",
+        "from elasticdl_tpu import obs\n"
+        "obs.gauge('w_step_seconds', 'h', labelnames=('worker_id',))\n",
+    )
+    assert check_metric_label_cardinality(seeded), (
+        "the rule no longer catches worker_id labels"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Acceptance end-to-end: master + heartbeating workers over real gRPC
+# ---------------------------------------------------------------------------
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as response:
+        return response.status, response.read().decode()
+
+
+def test_straggler_and_trace_end_to_end(obs_registry_snapshot):
+    """ISSUE acceptance: in a local master + 3 heartbeating workers, an
+    artificially slowed worker is flagged within a bounded number of
+    heartbeats (journal event + gauge on /metrics), clears when the
+    slowdown is removed, and a completed task's trace id links dispatch,
+    worker span, and completion across the journal."""
+    from elasticdl_tpu.common.constants import TaskExecCounterKey
+    from elasticdl_tpu.common.grpc_utils import RetryPolicy
+    from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
+    from elasticdl_tpu.master.servicer import (
+        MasterServicer,
+        start_master_server,
+    )
+    from elasticdl_tpu.master.task_manager import TaskManager
+    from elasticdl_tpu.obs.exporter import MetricsExporter
+    from elasticdl_tpu.parallel.elastic import HeartbeatReporter, WorldInfo
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.worker.master_client import MasterClient
+
+    test_start = time.time() - 1
+    task_manager = TaskManager(
+        training_shards={"shard": 64}, records_per_task=64
+    )
+    rendezvous = ElasticRendezvous(coordinator_port_fn=lambda host: 23456)
+    rendezvous.set_worker_hosts(
+        [(0, "127.0.0.1"), (1, "127.0.0.1"), (2, "127.0.0.1")]
+    )
+    aggregator = TelemetryAggregator(
+        detector=StragglerDetector(flag_after=2, clear_after=2),
+        current_workers_fn=lambda: [w for w, _h in rendezvous.world()],
+    )
+    advisories = []
+    aggregator.add_straggler_callback(
+        lambda wid, flagged, evidence: advisories.append((wid, flagged))
+    )
+    servicer = MasterServicer(
+        task_manager=task_manager,
+        rendezvous_server=rendezvous,
+        telemetry=aggregator,
+    )
+    server, port = start_master_server(servicer, port=0)
+    policy = RetryPolicy(
+        timeout_s=5.0, max_attempts=3, base_backoff_s=0.01,
+        max_backoff_s=0.05, jitter=0.0, total_budget_s=30.0,
+        wait_for_ready=True,
+    )
+    clients = [
+        MasterClient(f"localhost:{port}", worker_id=wid, retry_policy=policy)
+        for wid in range(3)
+    ]
+    telemetries = {
+        wid: WorkerTelemetry(wid, step_window=4) for wid in range(3)
+    }
+    reporters = [
+        HeartbeatReporter(
+            clients[wid],
+            WorldInfo(rank=wid, world_size=3, rendezvous_id=1,
+                      coordinator_addr=""),
+            host="127.0.0.1",
+            interval_s=0.05,
+            telemetry=telemetries[wid],
+        )
+        for wid in range(3)
+    ]
+    exporter = MetricsExporter(port=0).start()
+    reports_total = obs.registry().get("elasticdl_telemetry_reports_total")
+    try:
+        # Every worker has step telemetry; worker 2 is 50x slower.
+        for wid, per_step in ((0, 0.01), (1, 0.012), (2, 0.5)):
+            for _ in range(4):
+                telemetries[wid].record_steps(
+                    4, duration_s=4 * per_step, records=64
+                )
+        reports_before = reports_total.value()
+        for reporter in reporters:
+            reporter.start()
+
+        deadline = time.time() + 60
+        while time.time() < deadline and 2 not in aggregator.stragglers():
+            time.sleep(0.02)
+        assert 2 in aggregator.stragglers(), "slow worker never flagged"
+        heartbeats_used = reports_total.value() - reports_before
+        # Bounded detection: flag_after=2 means a handful of beats per
+        # worker, far under this ceiling even on a loaded CI box.
+        assert heartbeats_used <= 90, heartbeats_used
+        assert (2, True) in advisories
+
+        # The flag is visible on /metrics and in /journal.
+        status, text = _get(f"http://127.0.0.1:{exporter.port}/metrics")
+        assert status == 200
+        assert "\nelasticdl_stragglers 1" in text
+        assert "\nelasticdl_telemetry_workers 3" in text
+        assert "\nelasticdl_worker_step_time_p50_seconds " in text
+        status, body = _get(f"http://127.0.0.1:{exporter.port}/journal?n=500")
+        events = json.loads(body)["events"]
+        detected = [
+            e for e in events
+            if e["event"] == "straggler_detected" and e["ts"] >= test_start
+        ]
+        assert detected and detected[-1]["worker_id"] == 2
+        assert any(
+            e["event"] == "worker_telemetry" and e.get("worker_id") == 2
+            for e in events
+        )
+
+        # obs.top renders the straggler from the same endpoints.
+        from elasticdl_tpu.obs import top
+
+        frame = top.snapshot_frame(f"127.0.0.1:{exporter.port}", tail=500)
+        assert "STRAGGLER" in frame
+
+        # Remove the slowdown: fresh fast samples displace the slow
+        # window (step_window=4) and the flag clears.
+        for _ in range(6):
+            telemetries[2].record_steps(4, duration_s=4 * 0.011, records=64)
+        deadline = time.time() + 60
+        while time.time() < deadline and 2 in aggregator.stragglers():
+            time.sleep(0.02)
+        assert 2 not in aggregator.stragglers(), "straggler never cleared"
+        assert (2, False) in advisories
+        assert any(
+            e["event"] == "straggler_cleared" and e["ts"] >= test_start
+            for e in obs.journal().tail(500)
+        )
+
+        # ---- trace correlation across the process boundary ------------
+        task = clients[0].get_task()
+        assert task.task_id > 0 and task.trace_id
+        # Worker half: span journal record stamped with the dispatch id.
+        with obs.span(
+            "worker.task", labels={"type": "TRAINING"},
+            task_id=task.task_id, trace_id=task.trace_id,
+        ):
+            pass
+        # Completion over REAL gRPC with the trace id as call metadata.
+        clients[0].report_task_result(
+            task.task_id,
+            "",
+            exec_counters={TaskExecCounterKey.BATCH_COUNT: 1,
+                           TaskExecCounterKey.RECORD_COUNT: 64},
+            trace_id=task.trace_id,
+        )
+        chain = [
+            e for e in obs.journal().tail(500)
+            if e.get("trace_id") == task.trace_id
+        ]
+        kinds = [e["event"] for e in chain]
+        assert kinds == ["task_dispatch", "span", "task_done"], kinds
+        dispatch, span, done = chain
+        assert dispatch["worker_id"] == 0 and dispatch["task_id"] == task.task_id
+        assert span["name"] == "worker.task"
+        assert done["task_id"] == task.task_id
+        assert done["worker_id"] == 0
+        # The metadata echo matched the stored id: no mismatch field.
+        assert "reported_trace_id" not in done
+    finally:
+        for reporter in reporters:
+            reporter.stop()
+        exporter.stop()
+        for client in clients:
+            client.close()
+        server.stop(grace=None)
