@@ -46,13 +46,18 @@ test-fast: lint
 
 # Observability plane gate (docs/observability.md): registry semantics +
 # lockcheck concurrency, exporter endpoint round-trip, journal rotation,
-# the master end-to-end acceptance scrape, and the worker telemetry
-# plane (heartbeat snapshots, straggler detection, trace correlation,
-# obs.top) — then the journal schema validator's selftest.
+# the master end-to-end acceptance scrape, the worker telemetry plane
+# (heartbeat snapshots, straggler detection, trace correlation, obs.top),
+# and the goodput ledger/report plane — then the journal schema
+# validator's selftest + source-drift check and the postmortem report's
+# selftest over the golden journal fixture.
 test-obs:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
-	       tests/test_telemetry.py -q
-	python scripts/validate_journal.py --selftest
+	       tests/test_telemetry.py tests/test_goodput.py -q
+	python scripts/validate_journal.py --selftest --check-sources
+	python scripts/validate_journal.py tests/golden_journal.jsonl
+	JAX_PLATFORMS=cpu python -m elasticdl_tpu.obs.report \
+	       --selftest tests/golden_journal.jsonl
 
 # Transient-failure resilience gate: deterministic fault injection
 # (common/faults.py) + the master-SIGKILL / torn-checkpoint chaos e2e.

@@ -218,8 +218,17 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
     master.pod_manager = manager  # type: ignore[attr-defined]
     if master.telemetry is not None:
         # Straggler advisories from the telemetry plane flow to the pod
-        # manager (advisory only — see ElasticWorkerManager.note_straggler).
+        # manager (advisory only — see ElasticWorkerManager.note_straggler)
+        # and to the goodput ledger (training time while flagged is
+        # accounted as degraded_straggler).
+        from elasticdl_tpu.obs import goodput
+
         master.telemetry.add_straggler_callback(manager.note_straggler)
+        master.telemetry.add_straggler_callback(
+            lambda wid, flagged, _evidence: goodput.ledger().on_straggler(
+                wid, flagged
+            )
+        )
     if master.tensorboard_service is not None:
         master.tensorboard_service.bind(
             restarts_fn=lambda: manager.restarts_used

@@ -78,8 +78,20 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
         args, "checkpoint_dir", ""
     )
     if journal_dir:
+        from elasticdl_tpu.obs import goodput
+        from elasticdl_tpu.obs.journal import DEFAULT_FILENAME
+
+        resumed_journal = os.path.exists(
+            os.path.join(journal_dir, DEFAULT_FILENAME)
+        )
         journal_path = obs.init_journal(journal_dir)
         logger.info("Event journal -> %s", journal_path)
+        if resumed_journal:
+            # A predecessor's timeline exists: seed the goodput ledger's
+            # cumulative phase seconds so elasticdl_goodput_ratio keeps
+            # job-lifetime meaning across master restarts (the outage gap
+            # itself is attributed by obs.report from the journal).
+            goodput.ledger().seed_from_journal(journal_path)
 
     model_spec = model_spec or load_model_spec(args)
 
@@ -253,6 +265,11 @@ def start_master(args, model_spec=None, rendezvous_server=None) -> Master:
             master.metrics_exporter.port if master.metrics_exporter else None
         ),
     )
+    # Phase accounting starts here: idle until the first dispatch or
+    # world declaration opens a real phase.
+    from elasticdl_tpu.obs import goodput
+
+    goodput.ledger().transition("idle", cause="master_start")
     return master
 
 

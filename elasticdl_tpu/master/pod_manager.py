@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional
 from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.obs import goodput
 
 logger = get_logger("master.pod_manager")
 
@@ -250,8 +251,10 @@ class ElasticWorkerManager:
             handles = list(self._handles)
             self._handles = []
         logger.info("Scaling world to %d workers", num_workers)
+        goodput.ledger().on_rescale_detected("scale", len(handles))
         self._recover_world_tasks(handles)
         self._substrate_terminate(handles)
+        goodput.ledger().on_drain_complete(num_workers)
         with self._lock:
             # scale() is an external-caller entry point racing the monitor
             # thread's churn/regrow writes to the same sizing fields.
@@ -312,6 +315,7 @@ class ElasticWorkerManager:
             obs.journal().record(
                 "job_failed", reason=f"pod-manager monitor crashed: {exc}"
             )
+            goodput.ledger().finish("job_failed")
             self._substrate_terminate(handles)
             self._done_event.set()
 
@@ -339,6 +343,9 @@ class ElasticWorkerManager:
                 # Whole fleet exited cleanly (or job already done): finished.
                 logger.info("All workers exited; job done")
                 obs.journal().record(
+                    "job_complete", restarts_used=self.restarts_used
+                )
+                goodput.ledger().finish(
                     "job_complete", restarts_used=self.restarts_used
                 )
                 self._done_event.set()
@@ -406,8 +413,10 @@ class ElasticWorkerManager:
         obs.journal().record(
             "scale_up", old_size=current, new_size=new_size
         )
+        goodput.ledger().on_rescale_detected("scale_up", current)
         self._recover_world_tasks(handles)
         self._substrate_terminate(handles)
+        goodput.ledger().on_drain_complete(new_size)
         self._launch_world(new_size)
         return True
 
@@ -433,9 +442,13 @@ class ElasticWorkerManager:
             restarts_used=self._restarts_used,
             budget_left=budget_left,
         )
+        # Rescale-cost clock starts at detection; churn requeues below
+        # land inside the open record via TaskManager.recover_tasks.
+        goodput.ledger().on_rescale_detected("worker_churn", old_size)
         self._recover_world_tasks(handles)
         self._substrate_terminate(handles)  # survivors die with the world
         new_size = old_size if budget_left else old_size - 1
+        goodput.ledger().on_drain_complete(max(0, new_size))
         if new_size < 1:
             with self._lock:
                 self._failed_reason = reason = (
@@ -445,6 +458,7 @@ class ElasticWorkerManager:
                 self._stopped = True
             logger.error("Job failed: %s", reason)
             obs.journal().record("job_failed", reason=reason)
+            goodput.ledger().finish("job_failed")
             self._done_event.set()
             return
         logger.info(

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.obs import goodput
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 logger = get_logger("master.rendezvous")
@@ -140,6 +141,10 @@ class ElasticRendezvous:
                 coordinator,
                 worker_ids,
             )
+        # Goodput ledger (outside the lock — the hook journals): a world
+        # declaration opens/extends the rendezvous phase and stamps the
+        # rescale-cost tracker's drain->declaration edge.
+        goodput.ledger().on_world_declared(rendezvous_id, len(worker_ids))
         return rendezvous_id
 
     @property
@@ -210,6 +215,7 @@ class ElasticRendezvous:
         It rides the rank poll — NOT the liveness channel — so polling for
         a rank never counts as a heartbeat and the startup grace for
         never-heartbeated workers stays intact."""
+        formed_id = None
         with self._lock:
             self._record_host_locked(worker_id, host)
             self._resolve_coordinator_locked()
@@ -219,16 +225,22 @@ class ElasticRendezvous:
                 self._ranks_polled.add(worker_id)
                 if self._ranks_polled >= set(ids):
                     self._formation_observed = True
+                    formed_id = self._rendezvous_id
                     self._m_formation.observe(
                         time.monotonic() - self._world_declared_monotonic
                     )
-            return pb.GetCommRankResponse(
+            response = pb.GetCommRankResponse(
                 rank_id=rank,
                 world_size=len(self._workers),
                 rendezvous_id=self._rendezvous_id,
                 coordinator_addr=self._coordinator_addr,
                 worker_hosts=[host for _, host in self._workers],
             )
+        if formed_id is not None:
+            # Every member knows its rank: the rendezvous component of
+            # any in-flight rescale ends here (outside the lock).
+            goodput.ledger().on_world_formed(formed_id)
+        return response
 
     def report_liveness(self, worker_id: int, host: str, rendezvous_id: int) -> bool:
         """Heartbeat (also the host-advertisement channel); returns True

@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
+from elasticdl_tpu.obs import goodput
 from elasticdl_tpu.common.constants import TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -338,6 +339,16 @@ class TaskManager:
             # must never extend control-plane lock holds).
             for event in journal_events:
                 obs.journal().record(**event)
+            # Goodput ledger hooks (also outside the lock — they journal):
+            # a dispatch opens the work phase; timeout requeues add to the
+            # redo debt the ledger charges against goodput.
+            for event in journal_events:
+                if event["event"] == "task_requeue":
+                    goodput.ledger().note_requeue(
+                        event.get("records", 0), event["reason"]
+                    )
+                elif event["event"] == "task_dispatch":
+                    goodput.ledger().note_dispatch()
             if finished_epoch is not None:
                 obs.journal().record(
                     "train_epoch_done",
@@ -478,6 +489,18 @@ class TaskManager:
                     callbacks_to_run = list(self._tasks_done_callbacks)
         for event in journal_events:
             obs.journal().record(**event)
+        # Goodput accounting (outside the lock): completed training
+        # records repay any redo debt; failure requeues add to it.
+        training = task.type == pb.TRAINING
+        task_records = task.end - task.start
+        if success:
+            goodput.ledger().note_task_done(
+                records=task_records if training else 0, training=training
+            )
+        elif any(e["event"] == "task_requeue" for e in journal_events):
+            goodput.ledger().note_requeue(
+                task_records if training else 0, "failure"
+            )
         # Outside the lock: eval-done first (round finalization must see
         # the completed task before any job-level done callbacks run).
         for cb in eval_done_cbs:
@@ -511,12 +534,14 @@ class TaskManager:
                 if owner == worker_id
             ]
             trace_ids = []
+            churn_records = 0
             for tid in recovered:
                 _owner, task, _start, trace_id = self._doing.pop(tid)
                 trace_ids.append(trace_id)
                 self._todo.appendleft(task)
                 if task.type == pb.TRAINING:
                     self._recovered_record_count += task.end - task.start
+                    churn_records += task.end - task.start
             if recovered:
                 self._metrics.requeues.inc(
                     len(recovered), reason="worker_churn"
@@ -531,6 +556,9 @@ class TaskManager:
                 worker_id=worker_id,
                 task_ids=recovered,
                 trace_ids=trace_ids,
+            )
+            goodput.ledger().note_requeue(
+                churn_records, "worker_churn", tasks=len(recovered)
             )
         return len(recovered)
 
@@ -560,6 +588,13 @@ class TaskManager:
                     trace_id=trace_id,
                     worker_id=owner,
                     timeout_s=self._task_timeout_s,
+                    # Replay size: get()'s finally feeds this to the
+                    # goodput ledger's redo-debt accounting.
+                    records=(
+                        task.end - task.start
+                        if task.type == pb.TRAINING
+                        else 0
+                    ),
                 )
             )
             logger.info("Task %d timed out on worker %d; requeued", tid, owner)

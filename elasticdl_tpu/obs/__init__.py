@@ -34,6 +34,14 @@ master's TelemetryAggregator folds fleet aggregates into this registry
 `python -m elasticdl_tpu.obs.top` renders the per-worker view from the
 exporter's /metrics + /journal.  Imported lazily here to keep the base
 obs import free of the telemetry module (analysis tooling imports obs).
+
+The goodput plane (obs/goodput.py) partitions job wall-clock into
+exclusive phases (training / rendezvous / checkpoint / redo / ...)
+driven by control-plane and worker step-loop hooks, exports
+`elasticdl_goodput_ratio` + per-phase seconds + per-rescale cost
+breakdowns, and journals every edge; `python -m elasticdl_tpu.obs.report`
+replays the journal into a postmortem timeline + attribution report.
+Also imported lazily, for the same reason as telemetry.
 """
 
 from __future__ import annotations

@@ -147,6 +147,69 @@ class EventJournal:
         self._size = 0
 
     def tail(self, n: int = 50) -> List[dict]:
+        """Last `n` events.  Served from the in-memory ring when it can
+        cover the request; a larger `n` against a configured journal
+        reads the files instead — including the rotated file when the
+        active one holds fewer than `n` lines, so a request racing
+        rotation never loses the pre-rotation events.  The read happens
+        under the journal lock, which also serializes `_rotate_locked`'s
+        os.replace: a tail can never observe the half-swapped state."""
         with self._lock:
-            events = list(self._tail)
-        return events[-n:]
+            if self._file is None or len(self._tail) >= n:
+                return list(self._tail)[-n:]
+            return self._tail_from_disk_locked(n)
+
+    def _tail_from_disk_locked(self, n: int) -> List[dict]:
+        self._file.flush()
+        lines = self._read_tail_lines(self._path, n)
+        if len(lines) < n:
+            rotated = self._read_tail_lines(
+                self._path + ROTATED_SUFFIX, n - len(lines)
+            )
+            lines = rotated + lines
+        events = []
+        for line in lines[-n:]:
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue  # torn final line mid-write elsewhere
+            if isinstance(record, dict):
+                events.append(record)
+        return events
+
+    @staticmethod
+    def _read_tail_lines(path: str, n: int) -> List[str]:
+        """Last `n` non-empty lines, read in bounded blocks from EOF —
+        this runs under the journal lock, so it must cost O(tail), not
+        O(file): a /journal scrape must never stall every record()
+        caller behind a multi-MB sequential read."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                remaining = f.tell()
+                block = 1 << 16
+                data = b""
+                while remaining > 0 and data.count(b"\n") <= n:
+                    read = min(block, remaining)
+                    remaining -= read
+                    f.seek(remaining)
+                    data = f.read(read) + data
+                    block *= 2
+        except OSError:
+            return []
+        lines = [
+            stripped
+            for stripped in (
+                line.strip()
+                for line in data.decode(
+                    "utf-8", errors="replace"
+                ).splitlines()
+            )
+            if stripped
+        ]
+        if remaining > 0 and lines:
+            # Didn't reach the file head: the first line is (possibly) a
+            # fragment of a record; > n newlines were read, so >= n
+            # complete lines remain after dropping it.
+            lines = lines[1:]
+        return lines[-n:]

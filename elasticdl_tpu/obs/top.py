@@ -62,6 +62,47 @@ def parse_metrics(text: str) -> Dict[str, float]:
     return values
 
 
+def parse_labeled_gauge(text: str, name: str) -> Dict[str, float]:
+    """Samples of one single-label family: label value -> sample value
+    (enough for the goodput ledger's bounded `phase=` gauges)."""
+    values: Dict[str, float] = {}
+    prefix = name + "{"
+    for line in text.splitlines():
+        if not line.startswith(prefix):
+            continue
+        labels, _, sample = line[len(prefix):].partition("} ")
+        _, _, label_value = labels.partition('="')
+        label_value = label_value.rstrip('"')
+        try:
+            values[label_value] = float(sample)
+        except ValueError:
+            continue
+    return values
+
+
+def goodput_header(text: str) -> str:
+    """The job-level goodput line for the header — or "" when the master
+    predates the goodput ledger (old-master compatibility: degrade to
+    the classic header, never raise)."""
+    metrics = parse_metrics(text)
+    if "elasticdl_goodput_ratio" not in metrics:
+        return ""
+    bits = [f"goodput={metrics['elasticdl_goodput_ratio'] * 100:.1f}%"]
+    current = parse_labeled_gauge(text, "elasticdl_goodput_current_phase")
+    active = [phase for phase, value in current.items() if value >= 1]
+    if active:
+        bits.append(f"phase={active[0]}")
+    last_rescale = metrics.get("elasticdl_goodput_last_rescale_seconds")
+    if last_rescale:
+        bits.append(f"last_rescale={last_rescale:.1f}s")
+    redone = sum(
+        parse_labeled_gauge(text, "elasticdl_records_redone_total").values()
+    )
+    if redone:
+        bits.append(f"redone={int(redone)}rec")
+    return "  ".join(bits)
+
+
 def worker_rows(
     events: List[dict], now: Optional[float] = None
 ) -> List[dict]:
@@ -116,7 +157,11 @@ def _ms(seconds) -> str:
 
 
 def render(
-    rows: List[dict], metrics: Dict[str, float], addr: str = ""
+    rows: List[dict],
+    metrics: Dict[str, float],
+    addr: str = "",
+    job_header: str = "",
+    notes: Optional[List[str]] = None,
 ) -> str:
     """One status frame as plain text (also the --once output)."""
     header_bits = []
@@ -130,6 +175,8 @@ def render(
     lines = [
         f"elasticdl top — {addr}  " + "  ".join(header_bits),
     ]
+    if job_header:
+        lines.append(job_header)
     table: List[Tuple[str, ...]] = [_COLUMNS]
     for row in rows:
         table.append(
@@ -156,14 +203,30 @@ def render(
         )
     if not rows:
         lines.append("(no worker_telemetry events in the journal tail yet)")
+    for note in notes or ():
+        lines.append(note)
     return "\n".join(lines)
 
 
 def snapshot_frame(addr: str, tail: int = 256) -> str:
     base = addr if "://" in addr else f"http://{addr}"
-    metrics = parse_metrics(fetch_text(base + "/metrics"))
-    journal = json.loads(fetch_text(f"{base}/journal?n={tail}"))
-    return render(worker_rows(journal.get("events", [])), metrics, addr)
+    metrics_text = fetch_text(base + "/metrics")
+    # The journal endpoint is newer than /metrics: an old master without
+    # it degrades to the aggregate header, not a crash.
+    notes: List[str] = []
+    events: List[dict] = []
+    try:
+        journal = json.loads(fetch_text(f"{base}/journal?n={tail}"))
+        events = journal.get("events", [])
+    except (urllib.error.URLError, OSError, ValueError) as exc:
+        notes.append(f"(journal endpoint unavailable: {exc})")
+    return render(
+        worker_rows(events),
+        parse_metrics(metrics_text),
+        addr,
+        job_header=goodput_header(metrics_text),
+        notes=notes,
+    )
 
 
 def main(argv=None) -> int:

@@ -27,6 +27,7 @@ import numpy as np
 
 from elasticdl_tpu import obs
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.obs import goodput
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 logger = get_logger("parallel.elastic")
@@ -79,6 +80,20 @@ def join_world(
     """
     deadline = time.time() + timeout_s
     host = advertised_host()
+    # Worker-side goodput accounting: everything from the first rank poll
+    # to the coordination barrier completing is rendezvous time (this
+    # process's ledger — the master accounts its own half).
+    with goodput.ledger().phase("rendezvous", cause="join_world"):
+        return _join_world_inner(
+            master_client, poll_interval_s, deadline, host,
+            initialization_timeout_s,
+        )
+
+
+def _join_world_inner(
+    master_client, poll_interval_s, deadline, host,
+    initialization_timeout_s,
+) -> WorldInfo:
     while True:
         resp = master_client.get_comm_rank(host)
         if (

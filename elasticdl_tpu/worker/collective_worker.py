@@ -30,6 +30,7 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
 from elasticdl_tpu.data.columnar import materialize_columnar_task
 from elasticdl_tpu.data.dataset import Dataset, SequentialRecords, _stack
+from elasticdl_tpu.obs import goodput
 from elasticdl_tpu.parallel import elastic
 from elasticdl_tpu.parallel import sharding as shd
 from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
@@ -147,6 +148,12 @@ class CollectiveWorker:
     def restore_from_checkpoint(self):
         if self._ckpt is None:
             return
+        # Goodput: restore time is its own phase (this process's ledger)
+        # — after a re-formation it is part of what the rescale costs.
+        with goodput.ledger().phase("checkpoint_restore", cause="boot"):
+            self._restore_from_checkpoint_inner()
+
+    def _restore_from_checkpoint_inner(self):
         if self._sharded_ckpt:
             step = self._ckpt.latest_step()
             if step is not None:
@@ -225,6 +232,9 @@ class CollectiveWorker:
                 )
                 break
             if task.type == pb.WAIT:
+                # Worker-side ledger: queue momentarily empty -> idle
+                # until the next real task opens a work phase.
+                goodput.ledger().transition("idle", cause="wait_task")
                 time.sleep(self._wait_sleep_s)
                 continue
             spec = faults.fire("worker.task")
@@ -234,6 +244,7 @@ class CollectiveWorker:
                 type_name = pb.TaskType.Name(task.type)
             except ValueError:
                 type_name = "UNKNOWN"
+            goodput.ledger().transition("training", cause="task_start")
             if self._telemetry is not None:
                 self._telemetry.begin_task(
                     task.task_id, type_name, task.end - task.start
@@ -668,11 +679,14 @@ class CollectiveWorker:
             self._ckpt_steps and step - self._last_ckpt_step >= self._ckpt_steps
         )
         if due and step > 0 and step != self._last_ckpt_step:
-            if self._sharded_ckpt:
-                # Collective: every rank writes its own shard rows.
-                self._trainer.save_checkpoint(self._ckpt, step)
-            else:
-                host_state = self._trainer.state_to_host()
-                if self._world.is_leader:
-                    self._ckpt.save(host_state, step)
+            # Goodput: the save window (including the host gather every
+            # rank joins) is checkpoint_save, not training.
+            with goodput.ledger().phase("checkpoint_save", cause="cadence"):
+                if self._sharded_ckpt:
+                    # Collective: every rank writes its own shard rows.
+                    self._trainer.save_checkpoint(self._ckpt, step)
+                else:
+                    host_state = self._trainer.state_to_host()
+                    if self._world.is_leader:
+                        self._ckpt.save(host_state, step)
             self._last_ckpt_step = step

@@ -20,6 +20,7 @@ from elasticdl_tpu.common.constants import Mode, TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
 from elasticdl_tpu.data.task_data_service import TaskDataService
+from elasticdl_tpu.obs import goodput
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.worker.trainer import Trainer
 
@@ -94,6 +95,10 @@ class Worker:
                 logger.info("Job complete; worker %d exiting", self._mc.worker_id)
                 break
             if task.type == pb.WAIT:
+                # Ledger: nothing to do right now — idle, not training
+                # (in Local mode this is the same process-wide ledger the
+                # master hooks feed; the phases agree by construction).
+                goodput.ledger().transition("idle", cause="wait_task")
                 time.sleep(self._wait_sleep_s)
                 continue
             spec = faults.fire("worker.task")

@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import tempfile
 from typing import List, Tuple
@@ -45,6 +46,29 @@ EVENT_REQUIRED_FIELDS = {
     "scale_up": ("old_size", "new_size"),
     "span": ("name", "duration_s"),
     "job_failed": ("reason",),
+    # Goodput ledger (obs/goodput.py — docs/observability.md "Goodput").
+    "phase_transition": ("from", "to", "seconds"),
+    "rescale_cost": (
+        "cause", "total_s", "detection_s", "rendezvous_s", "redo_s",
+    ),
+    "goodput_summary": ("goodput_ratio", "wall_s", "phases"),
+}
+
+#: Every event type the repo is ALLOWED to emit.  Journal FILES stay
+#: open for extension (unknown events in a file pass — an old validator
+#: must not reject a newer master's journal), but the repo's own call
+#: sites must register here: ``--check-sources`` greps the source tree
+#: for journal emissions and fails on any name missing from this set,
+#: so schema drift can't recur silently.
+KNOWN_EVENTS = frozenset(EVENT_REQUIRED_FIELDS) | {
+    "task_progress_resume",
+    "train_epoch_done",
+    "job_complete",
+    "pod_create_failed",
+    "pod_pending_timeout",
+    "checkpoint_saved",
+    "checkpoint_restored",
+    "checkpoint_quarantined",
 }
 
 
@@ -84,6 +108,75 @@ def validate_file(path: str) -> List[Tuple[int, str]]:
     return problems
 
 
+#: Emission sites: a literal first argument to ``journal.record(...)``
+#: (possibly via ``obs.journal().record(...)``), or an ``event="..."``
+#: kwarg inside a record dict later splatted into ``record(**event)``.
+_RECORD_CALL_RE = re.compile(
+    r"\.record\(\s*[\"']([A-Za-z_][A-Za-z0-9_]*)[\"']"
+)
+_EVENT_KWARG_RE = re.compile(
+    r"\bevent\s*=\s*[\"']([A-Za-z_][A-Za-z0-9_]*)[\"']"
+)
+
+
+def scan_sources(root: str) -> List[Tuple[str, int, str]]:
+    """(path, line, event) for every journal emission whose event type is
+    not registered in KNOWN_EVENTS.  Scans the package source tree —
+    tests journal arbitrary demo events and are deliberately excluded."""
+    unknown, _scanned = scan_sources_counted(root)
+    return unknown
+
+
+def scan_sources_counted(root: str) -> Tuple[List[Tuple[str, int, str]], int]:
+    unknown: List[Tuple[str, int, str]] = []
+    scanned = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for filename in filenames:
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    text = f.read()
+            except (OSError, UnicodeDecodeError):
+                continue
+            scanned += 1
+            for regex in (_RECORD_CALL_RE, _EVENT_KWARG_RE):
+                for match in regex.finditer(text):
+                    event = match.group(1)
+                    if event not in KNOWN_EVENTS:
+                        line = text.count("\n", 0, match.start()) + 1
+                        unknown.append((path, line, event))
+    return unknown, scanned
+
+
+def _check_sources(root: str) -> int:
+    unknown, scanned = scan_sources_counted(root)
+    if scanned == 0:
+        # A gate that scanned nothing must not pass (same rule as the
+        # analysis CLI's zero-file-scan exit): a wrong cwd or a moved
+        # tree would otherwise silently disable drift detection.
+        print(
+            f"check-sources: no .py files under {root!r} — wrong "
+            "directory? (run from the repo root)", file=sys.stderr,
+        )
+        return 2
+    if unknown:
+        print(
+            "journal schema drift: event types emitted but not registered "
+            "in scripts/validate_journal.py KNOWN_EVENTS:", file=sys.stderr,
+        )
+        for path, line, event in sorted(unknown):
+            print(f"  {path}:{line}: {event!r}", file=sys.stderr)
+        return 1
+    print(
+        f"check-sources OK ({root}: {scanned} files, all emitted event "
+        "types registered)"
+    )
+    return 0
+
+
 def _selftest() -> int:
     """Generate a known-good and a known-bad journal and verify this
     validator tells them apart — the `make test-obs` sanity gate."""
@@ -98,10 +191,19 @@ def _selftest() -> int:
         {"ts": 5.0, "event": "straggler_detected", "worker_id": 1,
          "metric": "step_time", "value": 1.0},
         {"ts": 6.0, "event": "task_done", "task_id": 1, "trace_id": "t-1-1"},
+        {"ts": 6.2, "event": "phase_transition", "from": "idle",
+         "to": "training", "cause": "task_dispatch", "seconds": 1.5},
+        {"ts": 6.4, "event": "rescale_cost", "seq": 1,
+         "cause": "worker_churn", "total_s": 3.0, "detection_s": 0.5,
+         "rendezvous_s": 1.5, "redo_s": 1.0, "redo_records": 64},
+        {"ts": 6.6, "event": "goodput_summary", "goodput_ratio": 0.87,
+         "wall_s": 41.0, "phases": {"training": 35.7}},
         {"ts": 7.0, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
         '{"ts": 1.0, "event": "task_requeue"}',        # missing reason
+        '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
+        '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts
         '{"ts": "yesterday", "event": "span", "name": "x", "duration_s": 1}',
         '{"ts": 2.0}',                                  # no event
@@ -146,7 +248,17 @@ def main(argv=None) -> int:
         "--selftest", action="store_true",
         help="validate a generated good/bad pair and exit",
     )
+    parser.add_argument(
+        "--check-sources", nargs="?", const="elasticdl_tpu",
+        default=None, metavar="DIR",
+        help="scan the source tree (default: elasticdl_tpu) for journal "
+        "emissions with unregistered event types and fail on drift",
+    )
     args = parser.parse_args(argv)
+    if args.check_sources is not None:
+        status = _check_sources(args.check_sources)
+        if status or not (args.selftest or args.paths):
+            return status
     if args.selftest:
         return _selftest()
     if not args.paths:

@@ -37,10 +37,19 @@ def main(argv):
     faults.install_from_env()
     # Journal before anything else: both master generations append to the
     # same timeline, so the SIGKILL + resume cycle is reconstructable
-    # post-hoc (the chaos test asserts on these records).
+    # post-hoc (the chaos test asserts on these records).  The goodput
+    # ledger seeds from the predecessor's phase accounting the same way
+    # a real replacement master does (master/main.build_master).
     from elasticdl_tpu import obs
+    from elasticdl_tpu.obs import goodput
+    from elasticdl_tpu.obs.journal import DEFAULT_FILENAME
 
-    obs.init_journal(ckpt_dir)
+    predecessor_journal = os.path.exists(
+        os.path.join(ckpt_dir, DEFAULT_FILENAME)
+    )
+    journal_path = obs.init_journal(ckpt_dir)
+    if predecessor_journal:
+        goodput.ledger().seed_from_journal(journal_path)
 
     resumed = False
     resumed_finished = 0
@@ -59,8 +68,10 @@ def main(argv):
         )
 
     obs.journal().record(
-        "master_start", resumed=resumed, finished_records=resumed_finished
+        "master_start", job_name="chaos", resumed=resumed,
+        finished_records=resumed_finished,
     )
+    goodput.ledger().transition("idle", cause="master_start")
     servicer = MasterServicer(task_manager=task_manager)
     # The replacement master binds the SAME port its predecessor was
     # SIGKILLed on; brief bind failures (straggling kernel state) retry.
@@ -81,6 +92,9 @@ def main(argv):
     while not task_manager.finished():
         time.sleep(0.02)
     persister.stop()
+    # Terminal goodput accounting: the summary record the postmortem
+    # report (and the chaos test's report assertions) key off.
+    goodput.ledger().finish("job_complete")
     with open(os.path.join(ckpt_dir, DONE_FILE), "w") as f:
         json.dump(
             {

@@ -255,6 +255,41 @@ def test_master_sigkill_midjob_workers_ride_through(tmp_path):
                 f"gap in epoch {epoch}: "
                 f"{sorted(set(range(n_records)) - covered)[:10]}..."
             )
+
+        # Postmortem forensics: the goodput report replays the SAME
+        # journal into a timeline whose phase durations cover wall-clock
+        # and whose outage (the SIGKILL -> replacement gap) is attributed.
+        from elasticdl_tpu.obs import report as report_mod
+
+        summary = report_mod.summarize(
+            report_mod.load_events(str(ckpt_dir / "events.jsonl"))
+        )
+        wall = summary["wall_s"]
+        assert wall > 0
+        assert abs(sum(summary["phases"].values()) - wall) <= 0.02 * wall
+        assert summary["generations"] == 2
+        assert summary["outages"], "master outage not attributed"
+        assert summary["outage_s"] > 0
+        assert 0.0 < summary["goodput_ratio"] <= 1.0
+        assert summary["phases"].get("training", 0.0) > 0.0
+        assert summary["ledger_summary"]["outcome"] == "job_complete"
+        report_mod.render_report(summary)  # must not raise
+
+        # And the journal — including the goodput event types — passes
+        # the schema validator (the drift gate's runtime half).
+        check = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(
+                    os.path.dirname(TESTS_DIR), "scripts",
+                    "validate_journal.py",
+                ),
+                str(ckpt_dir / "events.jsonl"),
+            ],
+            capture_output=True,
+            text=True,
+        )
+        assert check.returncode == 0, check.stderr
     finally:
         if proc.poll() is None:
             proc.kill()

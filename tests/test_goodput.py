@@ -1,0 +1,554 @@
+"""Goodput ledger + postmortem report tests (elasticdl_tpu/obs/goodput,
+obs/report, the obs.top goodput header, and the journal schema drift
+gate).
+
+Covers the ISSUE 5 acceptance surface:
+
+- ledger state machine: exclusive phases, zero-length/same-phase edges,
+  monotonic-clock regression clamping, restart-resume seeding, and exact
+  requeue-redo accounting;
+- per-rescale cost records (detection/rendezvous/redo components,
+  superseded back-to-back churn);
+- the report tool: timeline covers wall-clock, outage attribution
+  between master generations, /metrics join;
+- an end-to-end: a real LocalProcessManager fleet with one induced
+  rescale, scraped over /metrics, whose replayed report agrees with the
+  live elasticdl_goodput_ratio gauge.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.obs import goodput
+from elasticdl_tpu.obs import report as report_mod
+from elasticdl_tpu.obs import top
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+GOLDEN = os.path.join(TESTS_DIR, "golden_journal.jsonl")
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, seconds):
+        self.t += seconds
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    """Point the process journal at a per-test file (the ledger journals
+    its edges there) and detach afterwards."""
+    path = obs.init_journal(str(tmp_path))
+    yield path
+    obs.journal().configure(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Ledger state machine
+# ---------------------------------------------------------------------------
+
+
+def test_transitions_accumulate_and_journal(journal_file, obs_registry_snapshot):
+    clock = FakeClock()
+    ledger = goodput.GoodputLedger(clock=clock)
+    record = ledger.transition("idle", cause="master_start")
+    assert record["from"] == "" and record["to"] == "idle"
+    clock.advance(2.0)
+    record = ledger.transition("rendezvous", cause="world_declared")
+    assert record["from"] == "idle" and record["seconds"] == 2.0
+    clock.advance(3.0)
+    ledger.transition("training", cause="task_dispatch")
+    clock.advance(5.0)
+    seconds = ledger.phase_seconds()
+    assert seconds["idle"] == 2.0
+    assert seconds["rendezvous"] == 3.0
+    assert seconds["training"] == 5.0  # open phase counts its elapsed
+    assert ledger.goodput_ratio() == pytest.approx(0.5)
+    kinds = [e["event"] for e in _events(journal_file)]
+    assert kinds.count("phase_transition") == 3
+
+
+def test_same_phase_transition_is_noop(journal_file):
+    clock = FakeClock()
+    ledger = goodput.GoodputLedger(clock=clock)
+    ledger.transition("training")
+    clock.advance(1.0)
+    assert ledger.transition("training", cause="again") is None
+    clock.advance(1.0)
+    assert ledger.phase_seconds()["training"] == 2.0  # one unbroken span
+    with pytest.raises(ValueError):
+        ledger.transition("not_a_phase")
+
+
+def test_clock_regression_clamps_to_zero(journal_file):
+    clock = FakeClock()
+    ledger = goodput.GoodputLedger(clock=clock)
+    ledger.transition("training")
+    clock.t -= 10.0  # a regressing clock must not charge negative time
+    record = ledger.transition("idle")
+    assert record["seconds"] == 0.0
+    assert ledger.phase_seconds()["training"] == 0.0
+    assert ledger.goodput_ratio() >= 0.0
+
+
+def test_phase_context_restores_previous(journal_file):
+    clock = FakeClock()
+    ledger = goodput.GoodputLedger(clock=clock)
+    ledger.transition("training")
+    clock.advance(1.0)
+    with ledger.phase("checkpoint_save", cause="cadence"):
+        clock.advance(4.0)
+        # Nested same-phase frames are free: no spurious edges.
+        with ledger.phase("checkpoint_save"):
+            clock.advance(1.0)
+    assert ledger.current_phase() == "training"
+    seconds = ledger.phase_seconds()
+    assert seconds["checkpoint_save"] == 5.0
+    assert seconds["training"] == 1.0
+
+
+def test_redo_accounting_is_exact(journal_file, obs_registry_snapshot):
+    clock = FakeClock()
+    ledger = goodput.GoodputLedger(clock=clock)
+    ledger.note_dispatch()
+    assert ledger.current_phase() == "training"
+    ledger.note_requeue(128, "worker_churn", tasks=2)
+    ledger.note_dispatch()
+    assert ledger.current_phase() == "requeue_redo"
+    clock.advance(2.0)
+    ledger.note_task_done(64)
+    assert ledger.current_phase() == "requeue_redo"  # 64 of 128 repaid
+    clock.advance(2.0)
+    ledger.note_task_done(64)
+    assert ledger.current_phase() == "training"  # debt exactly repaid
+    counts = ledger.counts()
+    assert counts["records_redone"] == 128
+    assert counts["redo_pending"] == 0
+    assert ledger.phase_seconds()["requeue_redo"] == 4.0
+    # Non-training completions never repay training debt.
+    ledger.note_requeue(32, "failure")
+    ledger.note_task_done(1000, training=False)
+    assert ledger.counts()["redo_pending"] == 32
+
+
+def test_rescale_cost_components(journal_file, obs_registry_snapshot):
+    clock = FakeClock()
+    ledger = goodput.GoodputLedger(clock=clock)
+    ledger.note_dispatch()
+    ledger.on_rescale_detected("worker_churn", old_size=2)
+    assert ledger.current_phase() == "rendezvous"
+    ledger.note_requeue(64, "worker_churn", tasks=1)
+    clock.advance(2.0)
+    ledger.on_drain_complete(2)
+    clock.advance(1.0)
+    ledger.on_world_declared(2, 2)
+    clock.advance(5.0)
+    ledger.on_world_formed(2)
+    ledger.note_dispatch()
+    clock.advance(8.0)
+    ledger.note_task_done(64)  # redo repaid with a formed world: closes
+    costs = [
+        e for e in _events(journal_file) if e["event"] == "rescale_cost"
+    ]
+    assert len(costs) == 1
+    cost = costs[0]
+    assert cost["cause"] == "worker_churn"
+    assert cost["old_size"] == 2 and cost["new_size"] == 2
+    assert cost["detection_s"] == pytest.approx(2.0)
+    assert cost["rendezvous_s"] == pytest.approx(6.0)
+    assert cost["redo_s"] == pytest.approx(8.0)
+    assert cost["total_s"] == pytest.approx(16.0)
+    assert cost["redo_records"] == 64 and cost["redo_tasks"] == 1
+    assert cost["rendezvous_id"] == 2 and cost["superseded"] is False
+
+
+def test_back_to_back_churn_supersedes_open_rescale(
+    journal_file, obs_registry_snapshot
+):
+    clock = FakeClock()
+    ledger = goodput.GoodputLedger(clock=clock)
+    ledger.on_rescale_detected("worker_churn", old_size=3)
+    clock.advance(1.0)
+    ledger.on_rescale_detected("worker_churn", old_size=2)
+    clock.advance(1.0)
+    ledger.on_world_declared(5, 2)
+    ledger.note_dispatch()
+    ledger.note_task_done(0)
+    costs = [
+        e for e in _events(journal_file) if e["event"] == "rescale_cost"
+    ]
+    assert [c["superseded"] for c in costs] == [True, False]
+    assert [c["seq"] for c in costs] == [1, 2]
+
+
+def test_straggler_flips_training_to_degraded(journal_file):
+    ledger = goodput.GoodputLedger(clock=FakeClock())
+    ledger.note_dispatch()
+    ledger.on_straggler(7, True)
+    assert ledger.current_phase() == "degraded_straggler"
+    ledger.on_straggler(8, True)
+    ledger.on_straggler(7, False)
+    assert ledger.current_phase() == "degraded_straggler"  # 8 still flagged
+    ledger.on_straggler(8, False)
+    assert ledger.current_phase() == "training"
+    # New dispatches while degraded land in the degraded phase.
+    ledger.on_straggler(9, True)
+    ledger.transition("idle")
+    ledger.note_dispatch()
+    assert ledger.current_phase() == "degraded_straggler"
+
+
+def test_finish_emits_goodput_summary(journal_file, obs_registry_snapshot):
+    clock = FakeClock()
+    ledger = goodput.GoodputLedger(clock=clock)
+    ledger.note_dispatch()
+    clock.advance(9.0)
+    ledger.transition("rendezvous")
+    clock.advance(1.0)
+    ledger.finish("job_complete")
+    ledger.finish("job_complete")  # idempotent: one summary only
+    summaries = [
+        e for e in _events(journal_file) if e["event"] == "goodput_summary"
+    ]
+    assert len(summaries) == 1
+    summary = summaries[0]
+    assert summary["outcome"] == "job_complete"
+    assert summary["goodput_ratio"] == pytest.approx(0.9)
+    assert summary["wall_s"] == pytest.approx(10.0)
+    assert summary["phases"] == {"training": 9.0, "rendezvous": 1.0}
+    assert ledger.current_phase() == "idle"
+
+
+def test_seed_from_journal_restores_cumulative_seconds(
+    tmp_path, obs_registry_snapshot
+):
+    path = obs.init_journal(str(tmp_path))
+    try:
+        clock = FakeClock()
+        first = goodput.GoodputLedger(clock=clock)
+        first.transition("idle")
+        clock.advance(2.0)
+        first.transition("training")
+        clock.advance(8.0)
+        first.transition("rendezvous")  # closes training at 8s
+        # SIGKILL here: rendezvous never closes; a replacement seeds what
+        # WAS accounted and its own accounting continues from there.
+        # 3 edges journaled, but the opening from="" edge closed nothing:
+        # only the 2 closed-phase transitions seed.
+        replacement = goodput.GoodputLedger(clock=clock)
+        assert replacement.seed_from_journal(path) == 2
+        seconds = replacement.phase_seconds()
+        assert seconds["idle"] == 2.0
+        assert seconds["training"] == 8.0
+        clock.advance(2.0)  # the outage gap: unaccounted by the live
+        replacement.transition("training")  # ledger (the report owns it)
+        clock.advance(10.0)
+        assert replacement.phase_seconds()["training"] == 18.0
+        assert replacement.goodput_ratio() == pytest.approx(18.0 / 20.0)
+        # Foreign/unreadable journals seed nothing.
+        fresh = goodput.GoodputLedger(clock=clock)
+        assert fresh.seed_from_journal(str(tmp_path / "nope.jsonl")) == 0
+        assert sum(fresh.phase_seconds().values()) == 0.0
+        # Pre-rotation accounting (events.jsonl.1) seeds too.
+        with open(path + ".1", "w") as f:
+            f.write(
+                '{"ts": 1.0, "event": "phase_transition", "from": '
+                '"training", "to": "idle", "seconds": 100.0}\n'
+            )
+        rotated_aware = goodput.GoodputLedger(clock=clock)
+        assert rotated_aware.seed_from_journal(path) == 3
+        assert rotated_aware.phase_seconds()["training"] == 108.0
+    finally:
+        obs.journal().configure(None)
+
+
+# ---------------------------------------------------------------------------
+# Report tool
+# ---------------------------------------------------------------------------
+
+
+def test_report_golden_outage_attribution_and_sums():
+    summary = report_mod.summarize(report_mod.load_events(GOLDEN))
+    wall = summary["wall_s"]
+    assert wall == pytest.approx(90.1)
+    assert sum(summary["phases"].values()) == pytest.approx(wall, rel=0.02)
+    assert summary["generations"] == 2
+    assert len(summary["outages"]) == 1
+    assert summary["outage_s"] == pytest.approx(12.0)
+    assert summary["phases"]["training"] == pytest.approx(46.0)
+    assert summary["goodput_ratio"] == pytest.approx(52.0 / 90.1, rel=1e-3)
+    (rescale,) = summary["rescales"]
+    assert rescale["cause"] == "worker_churn"
+    assert rescale["detection_s"] + rescale["rendezvous_s"] + rescale[
+        "redo_s"
+    ] == pytest.approx(rescale["total_s"])
+    text = report_mod.render_report(summary)
+    assert "master outage: 12.0s" in text
+    assert "worker_churn" in text and "redo of 64 requeued records" in text
+
+
+def test_report_selftest_and_cli_json_scrape(tmp_path, capsys):
+    assert report_mod.selftest(GOLDEN) == 0
+    scrape = tmp_path / "metrics.txt"
+    scrape.write_text(
+        "# TYPE elasticdl_goodput_ratio gauge\n"
+        "elasticdl_goodput_ratio 0.58\n"
+    )
+    out_json = tmp_path / "summary.json"
+    assert report_mod.main(
+        [GOLDEN, "--json", str(out_json), "--scrape", str(scrape)]
+    ) == 0
+    printed = capsys.readouterr().out
+    assert "goodput 57.7%" in printed
+    assert "elasticdl_goodput_ratio: 0.58" in printed
+    summary = json.loads(out_json.read_text())
+    assert summary["metrics_goodput_ratio"] == 0.58
+    assert abs(summary["goodput_ratio_delta"]) < 0.01
+    # Malformed trailing line (torn write at SIGKILL) is dropped, not fatal.
+    torn = tmp_path / "torn.jsonl"
+    with open(GOLDEN) as f:
+        torn.write_text(f.read() + '{"ts": 1754000091.0, "event": "tru')
+    assert report_mod.summarize(report_mod.load_events(str(torn)))[
+        "wall_s"
+    ] == pytest.approx(90.1)
+
+
+# ---------------------------------------------------------------------------
+# obs.top goodput header (satellite)
+# ---------------------------------------------------------------------------
+
+_TOP_METRICS = (
+    "elasticdl_world_size 2\n"
+    "elasticdl_goodput_ratio 0.873\n"
+    'elasticdl_goodput_current_phase{phase="training"} 1\n'
+    'elasticdl_goodput_current_phase{phase="idle"} 0\n'
+    "elasticdl_goodput_last_rescale_seconds 93.0\n"
+    'elasticdl_records_redone_total{reason="worker_churn"} 128\n'
+)
+
+
+def test_top_goodput_header_row():
+    header = top.goodput_header(_TOP_METRICS)
+    assert "goodput=87.3%" in header
+    assert "phase=training" in header
+    assert "last_rescale=93.0s" in header
+    assert "redone=128rec" in header
+    frame = top.render(
+        [], top.parse_metrics(_TOP_METRICS), "m:9090", job_header=header
+    )
+    assert "goodput=87.3%" in frame
+
+
+def test_top_degrades_without_goodput_or_journal():
+    # Old master: no goodput gauges -> no header row, never a raise.
+    assert top.goodput_header("elasticdl_world_size 2\n") == ""
+    frame = top.render(
+        [],
+        {"elasticdl_world_size": 2.0},
+        "m:9090",
+        job_header="",
+        notes=["(journal endpoint unavailable: HTTP Error 404)"],
+    )
+    assert "journal endpoint unavailable" in frame
+    assert "world=2" in frame
+
+
+# ---------------------------------------------------------------------------
+# Journal schema drift gate (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_journal",
+        os.path.join(REPO_ROOT, "scripts", "validate_journal.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_journal_source_scan_repo_clean_and_detects_drift(tmp_path):
+    validator = _load_validator()
+    assert validator.scan_sources(
+        os.path.join(REPO_ROOT, "elasticdl_tpu")
+    ) == []
+    drifting = tmp_path / "drifting.py"
+    drifting.write_text(
+        'obs.journal().record("totally_new_event", x=1)\n'
+        'events.append(dict(event="another_unregistered", y=2))\n'
+        'obs.journal().record("rendezvous", rendezvous_id=1)\n'
+    )
+    unknown = {
+        event for _p, _l, event in validator.scan_sources(str(tmp_path))
+    }
+    assert unknown == {"totally_new_event", "another_unregistered"}
+    # A scan that matched zero files must FAIL, not pass vacuously
+    # (wrong cwd would otherwise silently disable the drift gate).
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert validator._check_sources(str(empty)) == 2
+    assert validator._check_sources(str(tmp_path / "missing")) == 2
+
+
+def test_golden_journal_passes_schema_validation():
+    validator = _load_validator()
+    assert validator.validate_file(GOLDEN) == []
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: real fleet, one induced rescale, /metrics vs report
+# ---------------------------------------------------------------------------
+
+
+def test_rescale_e2e_report_and_metrics_agree(tmp_path, obs_registry_snapshot):
+    """A master-side control plane (task manager + rendezvous + real
+    LocalProcessManager fleet) runs a job with one induced worker-churn
+    rescale.  The journal replay and the live /metrics gauge must tell
+    the same goodput story, and the rescale must be attributed into
+    detection/rendezvous/redo components."""
+    from elasticdl_tpu.master.pod_manager import LocalProcessManager
+    from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
+    from elasticdl_tpu.master.task_manager import TaskManager
+    from elasticdl_tpu.obs.exporter import MetricsExporter
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+    journal_path = obs.init_journal(str(tmp_path))
+    ledger = goodput.reset_ledger()
+    sleeper = tmp_path / "sleeper.py"
+    sleeper.write_text("import time\ntime.sleep(120)\n")
+    exporter = None
+    manager = None
+    try:
+        obs.journal().record("master_start", job_name="goodput-e2e", port=0)
+        ledger.transition("idle", cause="master_start")
+        task_manager = TaskManager(
+            training_shards={"shard": 512}, records_per_task=64
+        )
+        rendezvous = ElasticRendezvous(coordinator_port_fn=lambda host: 29123)
+        manager = LocalProcessManager(
+            num_workers=2,
+            worker_argv_fn=lambda wid: [sys.executable, str(sleeper)],
+            rendezvous=rendezvous,
+            task_manager=task_manager,
+            max_restarts=2,
+            job_finished_fn=task_manager.finished,
+            poll_interval_s=0.05,
+        )
+        exporter = MetricsExporter(port=0).start()
+        manager.start()
+
+        # Real training time: an in-process "fleet" (worker id 99, not a
+        # supervised process, so churn never requeues ITS task) works the
+        # queue while the supervised sleepers provide the churn surface.
+        def work_one(min_s=0.15):
+            task = task_manager.get(99)
+            if task.task_id == -1:
+                if task.type == pb.WAIT:
+                    time.sleep(0.02)
+                    return True
+                return False
+            time.sleep(min_s)
+            task_manager.report(task.task_id, True, worker_id=99)
+            return True
+
+        for _ in range(3):
+            assert work_one(0.15)
+
+        # Induce the rescale: a task is in flight on the victim when it
+        # dies, so the churn requeues real records (the redo debt).
+        victims = manager.current_worker_ids()
+        assert len(victims) == 2
+        inflight = task_manager.get(victims[1])
+        assert inflight.task_id >= 0
+        manager.kill_worker(victims[1])
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            ids = manager.current_worker_ids()
+            if ids and not set(ids) & set(victims):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("world never re-formed after the kill")
+
+        while work_one():
+            pass
+        assert task_manager.finished()
+        manager.stop()
+        ledger.finish("job_complete")
+
+        # --- live gauge, scraped over real HTTP -----------------------
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics", timeout=10
+        ) as response:
+            text = response.read().decode()
+        live_ratio = report_mod.parse_metric_value(
+            text, "elasticdl_goodput_ratio"
+        )
+        assert live_ratio is not None and 0.0 < live_ratio <= 1.0
+        assert 'elasticdl_phase_seconds_total{phase="training"} ' in text
+        assert 'elasticdl_rescale_cost_seconds_count{component="total"} ' in text
+        assert 'elasticdl_records_redone_total{reason="worker_churn"} ' in text
+        # The top satellite renders its goodput header from this scrape.
+        assert "goodput=" in top.goodput_header(text)
+
+        # --- journal replay -------------------------------------------
+        summary = report_mod.summarize(report_mod.load_events(journal_path))
+        wall = summary["wall_s"]
+        assert wall > 1.0
+        assert sum(summary["phases"].values()) == pytest.approx(
+            wall, rel=0.02
+        )
+        assert summary["phases"].get("training", 0.0) > 0.0
+        rescales = [r for r in summary["rescales"] if not r["superseded"]]
+        assert len(rescales) == 1
+        rescale = rescales[0]
+        assert rescale["cause"] == "worker_churn"
+        assert rescale["redo_records"] == 64
+        assert rescale["detection_s"] + rescale["rendezvous_s"] + rescale[
+            "redo_s"
+        ] == pytest.approx(rescale["total_s"], abs=0.01)
+        # Live gauge vs replay: same story within the acceptance bound
+        # (small drift = idle seconds accrued between finish and scrape).
+        assert live_ratio == pytest.approx(
+            summary["goodput_ratio"], abs=0.05
+        )
+        assert report_mod.selftest(journal_path) == 0
+
+        # --- and the journal passes schema validation -----------------
+        check = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "scripts", "validate_journal.py"),
+                journal_path,
+            ],
+            capture_output=True,
+            text=True,
+        )
+        assert check.returncode == 0, check.stderr
+    finally:
+        if manager is not None:
+            manager.stop()
+        if exporter is not None:
+            exporter.stop()
+        obs.journal().configure(None)
+        goodput.reset_ledger()

@@ -220,6 +220,59 @@ def test_journal_rotation_at_size_cap(tmp_path):
     assert journal.tail(5)[-1]["i"] == 99
 
 
+def test_journal_tail_spans_rotation(tmp_path):
+    """Satellite: a tail larger than the in-memory ring reads the files,
+    and when the active file holds fewer than `n` lines (right after a
+    rotation) the rotated file's tail fills the rest — no gap."""
+    journal = EventJournal(
+        str(tmp_path / "events.jsonl"), max_bytes=3000, tail_events=8
+    )
+    for i in range(200):
+        journal.record("evt", i=i, pad="x" * 40)
+    assert (tmp_path / "events.jsonl.1").exists(), "cap never rotated"
+    # Contiguous across the rotation boundary: the active file alone
+    # holds far fewer than 100 lines at max_bytes=3000, so a correct
+    # tail must continue into the rotated file without a gap.
+    with open(tmp_path / "events.jsonl") as f:
+        active_lines = sum(1 for _ in f)
+    assert active_lines < 100
+    seq = [e["i"] for e in journal.tail(100)]
+    assert seq[-1] == 199
+    assert seq == list(range(seq[0], 200)), "gap across rotation boundary"
+    assert len(seq) > active_lines, "tail never read the rotated file"
+    # Small n still serves from the ring.
+    assert [e["i"] for e in journal.tail(3)] == [197, 198, 199]
+    journal.close()
+
+
+def test_journal_tail_consistent_during_forced_rotation(tmp_path):
+    """Rotation forced mid-tail: a writer hammers records (rotating
+    every ~40 lines) while a reader tails across the boundary — every
+    tail observes a contiguous, gap-free suffix."""
+    journal = EventJournal(
+        str(tmp_path / "events.jsonl"), max_bytes=2500, tail_events=4
+    )
+    stop = threading.Event()
+    failures = []
+
+    def writer():
+        for i in range(1500):
+            journal.record("evt", i=i, pad="y" * 40)
+        stop.set()
+
+    thread = threading.Thread(target=writer, name="journal-hammer", daemon=True)
+    thread.start()
+    while not stop.is_set():
+        tail = [e["i"] for e in journal.tail(30)]
+        if tail != list(range(tail[0], tail[0] + len(tail))):
+            failures.append(tail)
+            break
+    thread.join(timeout=30)
+    assert not thread.is_alive()
+    assert not failures, f"non-contiguous tail during rotation: {failures[0]}"
+    journal.close()
+
+
 def test_journal_memory_only_without_configuration():
     journal = EventJournal()
     journal.record("only_in_memory")
