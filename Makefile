@@ -19,14 +19,17 @@ native:
 test:
 	python -m pytest tests/ -q
 
-# Control-plane invariant analyzer (docs/invariants.md): every rule the
-# transient-failure design depends on, machine-checked.  Exit 1 on any
-# violation; suppress a deliberate exception with `# noqa-invariant: <rule>`.
+# Invariant analyzer (docs/invariants.md): the control-plane rules PLUS
+# the hot-path compute-plane family (jit-host-sync, retrace-hazard,
+# donation-discipline, trace-purity, sharding-coverage) over both the
+# package and the model zoo.  Exit 1 on any violation; suppress a
+# deliberate exception with `# noqa-invariant: <rule>`.
 check-invariants:
-	python -m elasticdl_tpu.analysis
+	python -m elasticdl_tpu.analysis elasticdl_tpu model_zoo
 
 # Static gate: ruff (errors-only baseline, config in pyproject.toml) when
-# available — the container may not ship it — then the invariant analyzer.
+# available — the container may not ship it — then the invariant analyzer,
+# with its JSON findings chased by the per-rule summary table.
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check .; \
@@ -34,7 +37,10 @@ lint:
 		echo "lint: ruff not installed; skipping style baseline" \
 		     "(F821/F401/E722 — see [tool.ruff] in pyproject.toml)"; \
 	fi
-	$(MAKE) check-invariants
+	@python -m elasticdl_tpu.analysis elasticdl_tpu model_zoo \
+		--format json > .invariant_findings.json; rc=$$?; \
+	python scripts/invariant_report.py .invariant_findings.json; \
+	rm -f .invariant_findings.json; exit $$rc
 
 # Tier-1 fast gate: lint + invariants first (cheap, seconds), then the
 # correctness surface without the compile-heavy `slow`-marked tests

@@ -6,9 +6,12 @@ injection is clock- and randomness-free, and the master services guard
 shared state behind locks (docs/failure_model.md).  This package turns
 those contracts into tooling:
 
-- a static AST analyzer (`python -m elasticdl_tpu.analysis`,
-  `make check-invariants`) with one checker per rule — see
-  `elasticdl_tpu.analysis.rules` and docs/invariants.md;
+- a static analyzer (`python -m elasticdl_tpu.analysis`,
+  `make check-invariants`) with one checker per rule: the syntactic
+  control-plane rules (`elasticdl_tpu.analysis.rules`) plus the
+  flow-aware hot-path family for the TPU compute plane
+  (`elasticdl_tpu.analysis.jax_rules`, built on the tracedness core in
+  `elasticdl_tpu.analysis.traced`) — see docs/invariants.md;
 - a runtime lock-order race detector (`elasticdl_tpu.analysis.runtime`)
   armed by ``ELASTICDL_LOCKCHECK=1`` that records per-thread lock
   acquisition order, flags lock-order inversions, and reports
@@ -29,8 +32,13 @@ _EXPORTS = {
     "discover_files": "core",
     "format_violations": "core",
     "run_checks": "core",
+    "scan": "core",
+    "ScanReport": "core",
     "ALL_RULES": "rules",
     "RULE_NAMES": "rules",
+    "JAX_RULES": "jax_rules",
+    "TracedIndex": "traced",
+    "traced_index": "traced",
 }
 
 

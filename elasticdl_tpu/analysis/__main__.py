@@ -1,47 +1,126 @@
 """CLI: ``python -m elasticdl_tpu.analysis [paths...] [--rule NAME]``.
 
 Exit status: 0 when every invariant holds, 1 when violations were found,
-2 on usage errors.  With no paths, scans the installed ``elasticdl_tpu``
-package (the production control plane — tests are exercised separately
-by tests/test_analysis.py fixtures).
+2 on usage errors (including a scan that matched zero files, and an
+unreadable --baseline).  With no paths, scans the installed
+``elasticdl_tpu`` package (the production control plane) plus the
+sibling ``model_zoo`` tree when present (the compute-plane scope of the
+hot-path rules — tests are exercised separately by
+tests/test_analysis.py fixtures).
+
+``--format json`` emits stable machine-readable findings::
+
+    {"findings": [{"rule", "path", "line", "col", "message"}, ...],
+     "suppressed": N, "suppressed_by_rule": {...},
+     "files_scanned": N, "rules": [...]}
+
+``--baseline FILE`` reads a JSON allowlist (the same shape as the
+``--format json`` output, or a bare list of findings) and drops any
+finding matching a baseline entry by (rule, path[, message]) — so a new
+rule can gate incrementally: snapshot today's findings, burn the
+baseline down over time.  Baselined findings count as suppressed.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from elasticdl_tpu.analysis.core import (
     discover_files,
     format_violations,
-    run_checks,
+    scan,
 )
 from elasticdl_tpu.analysis.rules import ALL_RULES, RULE_NAMES
 
 
 def default_paths():
     package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return [package_dir]
+    paths = [package_dir]
+    model_zoo = os.path.join(os.path.dirname(package_dir), "model_zoo")
+    if os.path.isdir(model_zoo):
+        paths.append(model_zoo)
+    return paths
+
+
+def _load_baseline(path: str):
+    """Baseline entries as a list of dicts with rule/path[/message]."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("findings", [])
+    if not isinstance(data, list):
+        raise ValueError("baseline must be a JSON list or {'findings': [...]}")
+    entries = []
+    for item in data:
+        if not isinstance(item, dict) or "rule" not in item or "path" not in item:
+            raise ValueError(
+                "each baseline entry needs at least 'rule' and 'path'"
+            )
+        entries.append(item)
+    return entries
+
+
+def _normalize(path: str) -> str:
+    return os.path.normpath(path).replace("\\", "/")
+
+
+def _baselined(violation, entries) -> bool:
+    v_path = _normalize(violation.path)
+    for entry in entries:
+        if entry["rule"] != violation.rule:
+            continue
+        e_path = _normalize(str(entry["path"]))
+        # Exact match, or a suffix match across an absolute/relative
+        # spelling difference — but only when the shorter path still
+        # carries a directory component: a bare basename entry
+        # ('trainer.py') must NOT allowlist every trainer.py in the tree.
+        if v_path != e_path:
+            if "/" in e_path and v_path.endswith("/" + e_path):
+                pass
+            elif "/" in v_path and "/" in e_path and e_path.endswith(
+                "/" + v_path
+            ):
+                pass
+            else:
+                continue
+        if "message" in entry and entry["message"] != violation.message:
+            continue
+        return True
+    return False
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m elasticdl_tpu.analysis",
         description="Invariant analyzer for the elastic control plane "
-        "(docs/invariants.md).",
+        "and the TPU compute plane (docs/invariants.md).",
     )
     parser.add_argument(
         "paths",
         nargs="*",
         help="files or directories to scan (default: the elasticdl_tpu "
-        "package)",
+        "package plus model_zoo/)",
     )
     parser.add_argument(
         "--rule",
         action="append",
         choices=RULE_NAMES,
         help="run only this rule (repeatable; default: all rules)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (json: stable machine-readable findings)",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="JSON allowlist of known findings to ignore (same shape as "
+        "--format json output); lets a new rule gate incrementally",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
@@ -53,6 +132,15 @@ def main(argv=None) -> int:
             doc = (ALL_RULES[name].__doc__ or "").strip().splitlines()
             print(f"{name}: {doc[0] if doc else ''}")
         return 0
+
+    baseline = []
+    if args.baseline:
+        try:
+            baseline = _load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"error: unreadable --baseline {args.baseline}: {exc}",
+                  file=sys.stderr)
+            return 2
 
     rules = [ALL_RULES[name] for name in (args.rule or RULE_NAMES)]
     paths = args.paths or default_paths()
@@ -68,7 +156,43 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    violations = run_checks(paths, rules)
+    report = scan(paths, rules)
+    violations = report.violations
+    suppressed = list(report.suppressed)
+    if baseline:
+        surviving = []
+        for violation in violations:
+            if _baselined(violation, baseline):
+                suppressed.append(violation)
+            else:
+                surviving.append(violation)
+        violations = surviving
+
+    if args.format == "json":
+        by_rule = {}
+        for violation in suppressed:
+            by_rule[violation.rule] = by_rule.get(violation.rule, 0) + 1
+        print(json.dumps(
+            {
+                "findings": [
+                    {
+                        "rule": v.rule,
+                        "path": v.path,
+                        "line": v.line,
+                        "col": v.col,
+                        "message": v.message,
+                    }
+                    for v in violations
+                ],
+                "suppressed": len(suppressed),
+                "suppressed_by_rule": by_rule,
+                "files_scanned": len(report.files),
+                "rules": list(args.rule or RULE_NAMES),
+            },
+            indent=2,
+        ))
+        return 1 if violations else 0
+
     if violations:
         print(format_violations(violations))
         print(

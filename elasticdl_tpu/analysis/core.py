@@ -62,6 +62,9 @@ class SourceFile:
     noqa: Dict[int, Set[str]] = field(default_factory=dict)
     #: line number -> full comment text on that line (if any)
     comments: Dict[int, str] = field(default_factory=dict)
+    #: decorator line -> line of the `def` it decorates: a suppression on
+    #: the def line covers violations reported on its decorator lines.
+    decorated_def_line: Dict[int, int] = field(default_factory=dict)
 
     @classmethod
     def parse(cls, path: str, text: Optional[str] = None) -> "SourceFile":
@@ -71,7 +74,20 @@ class SourceFile:
         tree = ast.parse(text, filename=path)
         source = cls(path=path, text=text, tree=tree)
         source._collect_comments()
+        source._map_decorator_lines()
         return source
+
+    def _map_decorator_lines(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if not node.decorator_list:
+                continue
+            first = node.decorator_list[0].lineno
+            for line in range(first, node.lineno):
+                self.decorated_def_line[line] = node.lineno
 
     def _collect_comments(self):
         try:
@@ -121,8 +137,13 @@ class SourceFile:
         return mapping
 
     def suppressed(self, rule: str, line: int) -> bool:
-        names = self.noqa.get(line)
-        return bool(names) and (rule in names or "*" in names)
+        for candidate in (line, self.decorated_def_line.get(line)):
+            if candidate is None:
+                continue
+            names = self.noqa.get(candidate)
+            if names and (rule in names or "*" in names):
+                return True
+        return False
 
 
 def discover_files(paths: Sequence[str]) -> List[str]:
@@ -142,14 +163,27 @@ def discover_files(paths: Sequence[str]) -> List[str]:
     return found
 
 
-def run_checks(
+@dataclass
+class ScanReport:
+    """Full result of one analyzer pass: surviving violations, the
+    noqa-suppressed ones (for reporting), and the files scanned."""
+
+    violations: List[Violation]
+    suppressed: List[Violation]
+    files: List[str]
+
+
+def scan(
     paths: Sequence[str],
     rules: Iterable[Callable[[SourceFile], List[Violation]]],
-) -> List[Violation]:
-    """Run `rules` over every .py under `paths`; suppressions applied."""
+) -> ScanReport:
+    """Run `rules` over every .py under `paths`, splitting findings into
+    surviving vs inline-suppressed."""
     rules = list(rules)
     violations: List[Violation] = []
-    for file_path in discover_files(paths):
+    suppressed: List[Violation] = []
+    files = discover_files(paths)
+    for file_path in files:
         try:
             source = SourceFile.parse(file_path)
         except SyntaxError as exc:
@@ -178,10 +212,23 @@ def run_checks(
             continue
         for rule in rules:
             for violation in rule(source):
-                if not source.suppressed(violation.rule, violation.line):
+                if source.suppressed(violation.rule, violation.line):
+                    suppressed.append(violation)
+                else:
                     violations.append(violation)
-    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
-    return violations
+    key = lambda v: (v.path, v.line, v.col, v.rule)  # noqa: E731
+    violations.sort(key=key)
+    suppressed.sort(key=key)
+    return ScanReport(violations=violations, suppressed=suppressed,
+                      files=files)
+
+
+def run_checks(
+    paths: Sequence[str],
+    rules: Iterable[Callable[[SourceFile], List[Violation]]],
+) -> List[Violation]:
+    """Run `rules` over every .py under `paths`; suppressions applied."""
+    return scan(paths, rules).violations
 
 
 def format_violations(violations: Sequence[Violation]) -> str:

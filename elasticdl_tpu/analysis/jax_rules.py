@@ -1,0 +1,591 @@
+"""Hot-path hygiene rules for the compute plane (JAX/TPU contracts).
+
+PR 2's rules machine-check the *control* plane; these check the
+*compute* plane — the jitted step functions in `parallel/`, the Pallas
+kernels in `ops/`, and the model zoo.  They encode the TPU performance
+contracts the repo follows by convention (docs/invariants.md
+"Hot-path rules"), on top of the flow-aware tracedness core in
+`analysis/traced.py`: every rule asks "does this statement execute
+under a JAX trace?" instead of pattern-matching single lines.
+
+Rules
+-----
+jit-host-sync        no `.item()` / `float()`/`int()` on arrays /
+                     `np.asarray` / `print` / `jax.device_get` reachable
+                     under trace — each is a device sync, a tracer leak,
+                     or a per-trace host round-trip.
+retrace-hazard       no `jax.jit` constructed inside a loop or per-step
+                     method, no `static_argnums` pointing at unhashable
+                     defaults, no mutable-container closure capture from
+                     host scope into a traced callable.
+donation-discipline  jitted train/window steps donate their state arg
+                     (`donate_argnums`), and a donated argument is never
+                     read after the donating call in the caller.
+trace-purity         no obs registry/journal calls, file IO, or lock
+                     acquisition reachable under trace — the obs plane
+                     must never be traced into a step.
+sharding-coverage    on the multi-device path (`parallel/`, or a file
+                     carrying `# multi-device-path`), every `jax.jit`
+                     declares in/out shardings or runs under a mesh
+                     context.
+
+Stdlib-only, like the rest of the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticdl_tpu.analysis.core import SourceFile, Violation
+from elasticdl_tpu.analysis.traced import (
+    FunctionInfo,
+    TracedIndex,
+    traced_index,
+)
+
+# ---------------------------------------------------------------------------
+# Shared helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _violation(rule: str, source: SourceFile, node: ast.AST, message: str
+               ) -> Violation:
+    return Violation(
+        rule=rule,
+        path=source.path,
+        line=getattr(node, "lineno", 0),
+        col=getattr(node, "col_offset", 0),
+        message=message,
+    )
+
+
+def _where(index: TracedIndex, info: FunctionInfo) -> str:
+    """'in `f` (traced: <reason>)' context suffix for messages."""
+    return f"in `{info.name}` (traced: {index.reason(info.qualname)})"
+
+
+# ---------------------------------------------------------------------------
+# Rule: jit-host-sync
+# ---------------------------------------------------------------------------
+
+#: numpy namespaces whose array constructors force device->host.
+_NP_ROOTS = frozenset({"np", "numpy", "onp"})
+_NP_SYNC_FNS = frozenset({"asarray", "array", "copy"})
+_SYNC_METHODS = frozenset({"item", "tolist"})
+
+
+def check_jit_host_sync(source: SourceFile) -> List[Violation]:
+    """No host syncs (.item()/float()/np.asarray/print/device_get) under
+    trace."""
+    index = traced_index(source)
+    violations: List[Violation] = []
+    for info in index.traced_infos():
+        tainted = index.array_tainted_names(info)
+        for node in index.own_body(info):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _SYNC_METHODS
+                and not node.args
+            ):
+                violations.append(_violation(
+                    "jit-host-sync", source, node,
+                    f".{func.attr}() {_where(index, info)} — forces a "
+                    "device->host sync (or a tracer error) inside the "
+                    "compiled step; return the array and read it on the "
+                    "host side of the jit boundary",
+                ))
+                continue
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "block_until_ready"
+            ):
+                violations.append(_violation(
+                    "jit-host-sync", source, node,
+                    f".block_until_ready() {_where(index, info)} — a "
+                    "host-side synchronization primitive has no meaning "
+                    "under trace; sync outside the jitted call",
+                ))
+                continue
+            dotted = _dotted(func)
+            if dotted and dotted.split(".")[-1] == "device_get":
+                violations.append(_violation(
+                    "jit-host-sync", source, node,
+                    f"jax.device_get(...) {_where(index, info)} — "
+                    "device_get under trace forces a host round-trip per "
+                    "step; keep values on device and fetch after the call",
+                ))
+                continue
+            if isinstance(func, ast.Name) and func.id == "print":
+                violations.append(_violation(
+                    "jit-host-sync", source, node,
+                    f"print(...) {_where(index, info)} — runs once at "
+                    "trace time (not per step) and syncs if it touches a "
+                    "tracer; use jax.debug.print for traced values",
+                ))
+                continue
+            if (
+                isinstance(func, ast.Name)
+                and func.id in ("float", "int")
+                and len(node.args) == 1
+                and index.expr_tainted(node.args[0], tainted)
+            ):
+                violations.append(_violation(
+                    "jit-host-sync", source, node,
+                    f"{func.id}(...) on a traced array {_where(index, info)}"
+                    " — concretizing a tracer is a per-step device sync "
+                    "(or a ConcretizationTypeError); keep the value as a "
+                    "jnp array",
+                ))
+                continue
+            if (
+                dotted
+                and dotted.split(".")[0] in _NP_ROOTS
+                and dotted.split(".")[-1] in _NP_SYNC_FNS
+                and any(
+                    index.expr_tainted(arg, tainted) for arg in node.args
+                )
+            ):
+                violations.append(_violation(
+                    "jit-host-sync", source, node,
+                    f"{dotted}(...) on a traced value {_where(index, info)}"
+                    " — numpy materializes on the host (a sync, or a "
+                    "TracerArrayConversionError under jit); use jnp",
+                ))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Rule: retrace-hazard
+# ---------------------------------------------------------------------------
+
+#: Method names that run once per training step: constructing a jit
+#: object there mints a fresh cache per call.
+_PER_STEP_NAME_RE = re.compile(r"(^|_)step$|^step_")
+
+#: Expressions that build mutable containers.
+_MUTABLE_FACTORIES = frozenset(
+    {"list", "dict", "set", "defaultdict", "deque", "OrderedDict", "Counter"}
+)
+
+
+def _is_mutable_container_expr(expr: ast.AST) -> bool:
+    if isinstance(expr, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        segment = None
+        if isinstance(expr.func, ast.Name):
+            segment = expr.func.id
+        elif isinstance(expr.func, ast.Attribute):
+            segment = expr.func.attr
+        return segment in _MUTABLE_FACTORIES
+    return False
+
+
+def _local_names(index: TracedIndex, info: FunctionInfo) -> Set[str]:
+    names: Set[str] = set(info.params)
+    node = info.node
+    if not isinstance(node, ast.Lambda):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if sub is not node:
+                    names.add(sub.name)
+    for sub in index.own_body(info):
+        if isinstance(sub, ast.Name) and isinstance(
+            sub.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(sub.id)
+    return names
+
+
+def check_retrace_hazard(source: SourceFile) -> List[Violation]:
+    """No per-step/in-loop jit construction, unhashable static args, or
+    mutable host closures captured into traced callables."""
+    index = traced_index(source)
+    violations: List[Violation] = []
+
+    for site in index.jit_sites:
+        if site.in_loop:
+            violations.append(_violation(
+                "retrace-hazard", source, site.node,
+                f"{site.entry}(...) constructed inside a loop — every "
+                "iteration mints a fresh jit object with an empty "
+                "compile cache (a retrace per step); hoist construction "
+                "out of the loop and reuse the compiled callable",
+            ))
+        elif site.enclosing_function:
+            enclosing = index.functions.get(site.enclosing_function)
+            if enclosing and _PER_STEP_NAME_RE.search(enclosing.name):
+                violations.append(_violation(
+                    "retrace-hazard", source, site.node,
+                    f"{site.entry}(...) constructed inside per-step "
+                    f"method `{enclosing.name}` — jit objects must be "
+                    "built once (init/compile time) and reused; "
+                    "rebuilding per step recompiles per step",
+                ))
+        # static_argnums pointing at a parameter with an unhashable
+        # default: every call hashes the static value; a list/dict
+        # default raises (or silently retraces via repr fallbacks).
+        if site.target:
+            target = index.functions.get(site.target)
+            if target is not None and not isinstance(target.node, ast.Lambda):
+                offset = (
+                    1
+                    if (
+                        target.is_method
+                        and target.params
+                        and target.params[0] in ("self", "cls")
+                        and not site.is_decorator
+                    )
+                    else 0
+                )
+                args = target.node.args
+                defaults: Dict[str, ast.AST] = {}
+                plain = args.posonlyargs + args.args
+                for param, default in zip(
+                    plain[len(plain) - len(args.defaults):], args.defaults
+                ):
+                    defaults[param.arg] = default
+                for param, default in zip(args.kwonlyargs, args.kw_defaults):
+                    if default is not None:
+                        defaults[param.arg] = default
+                for pos in site.static_positions():
+                    idx = pos + offset
+                    if idx >= len(target.params):
+                        continue
+                    name = target.params[idx]
+                    default = defaults.get(name)
+                    if default is not None and _is_mutable_container_expr(
+                        default
+                    ):
+                        violations.append(_violation(
+                            "retrace-hazard", source, site.node,
+                            f"static_argnums includes `{name}`, whose "
+                            "default is an unhashable container — static "
+                            "args are hashed per call (TypeError at best, "
+                            "a retrace per distinct object at worst); "
+                            "use a tuple/frozen value",
+                        ))
+
+    # Mutable-container closure capture: host state baked into a trace.
+    for info in index.traced_infos():
+        parent_qualname = info.parent_function
+        if not parent_qualname or parent_qualname in index.traced:
+            continue  # captures between traced fns are one trace: fine
+        parent = index.functions.get(parent_qualname)
+        if parent is None:
+            continue
+        mutable_locals: Set[str] = set()
+        for node in index.own_body(parent):
+            if isinstance(node, ast.Assign) and _is_mutable_container_expr(
+                node.value
+            ):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        mutable_locals.add(target.id)
+        if not mutable_locals:
+            continue
+        local = _local_names(index, info)
+        for node in index.own_body(info):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in mutable_locals
+                and node.id not in local
+            ):
+                violations.append(_violation(
+                    "retrace-hazard", source, node,
+                    f"traced `{info.name}` captures mutable container "
+                    f"`{node.id}` from host scope — its contents are "
+                    "frozen at trace time (silent staleness) and "
+                    "appending from inside the trace never happens per "
+                    "step; pass data as an argument instead",
+                ))
+                break  # one finding per captured fn is enough
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Rule: donation-discipline
+# ---------------------------------------------------------------------------
+
+#: First-parameter names that identify the training state a step should
+#: donate (buffer reuse halves peak memory for the update).
+_STATE_PARAM_NAMES = frozenset({"state", "train_state", "st", "carry"})
+
+
+def check_donation_discipline(source: SourceFile) -> List[Violation]:
+    """Jitted train steps donate their state; donated args are dead
+    after the call."""
+    index = traced_index(source)
+    violations: List[Violation] = []
+
+    for site in index.jit_sites:
+        if site.target is None:
+            continue
+        target = index.functions.get(site.target)
+        if target is None or "train" not in target.name.lower():
+            continue
+        data_params = target.data_params
+        if not data_params or data_params[0] not in _STATE_PARAM_NAMES:
+            continue
+        if (
+            "donate_argnums" in site.keywords
+            or "donate_argnames" in site.keywords
+        ):
+            continue
+        violations.append(_violation(
+            "donation-discipline", source, site.node,
+            f"jitted train step `{target.name}` takes state "
+            f"`{data_params[0]}` but declares no donate_argnums — "
+            "without donation XLA keeps input AND output state buffers "
+            "live across the update (double peak memory for params + "
+            "optimizer state); donate the state argument",
+        ))
+
+    # Use-after-donate: the donated buffer is invalid after the call.
+    donated = index.donated_callables()
+    if donated:
+        for info in index.functions.values():
+            _check_use_after_donate(source, index, info, donated, violations)
+    return violations
+
+
+def _check_use_after_donate(
+    source: SourceFile,
+    index: TracedIndex,
+    info: FunctionInfo,
+    donated: Dict[str, Tuple[int, ...]],
+    violations: List[Violation],
+):
+    calls: List[Tuple[ast.Call, str]] = []  # (call, donated Name id)
+    for node in index.own_body(info):
+        if not isinstance(node, ast.Call):
+            continue
+        segment = None
+        if isinstance(node.func, ast.Attribute):
+            segment = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            segment = node.func.id
+        positions = donated.get(segment or "")
+        if not positions:
+            continue
+        for pos in positions:
+            if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                calls.append((node, node.args[pos].id))
+    if not calls:
+        return
+    # A store in the SAME statement kills the donated name (the idiom
+    # `state, loss = self._train_step(state, ...)` re-binds it).
+    rebinding: Set[int] = set()  # id(call) when the assignment re-binds
+    for stmt in index.own_body(info):
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        stored = {
+            sub.id
+            for target in (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for sub in ast.walk(target)
+            if isinstance(sub, ast.Name)
+        }
+        if not stored:
+            continue
+        value = stmt.value
+        if value is None:
+            continue
+        inner = {id(sub) for sub in ast.walk(value)}
+        for call, name in calls:
+            if id(call) in inner and name in stored:
+                rebinding.add(id(call))
+    for call, name in calls:
+        if id(call) in rebinding:
+            continue
+        call_end = (call.end_lineno or call.lineno,
+                    call.end_col_offset or call.col_offset)
+        events: List[Tuple[Tuple[int, int], bool, ast.Name]] = []
+        for node in index.own_body(info):
+            if isinstance(node, ast.Name) and node.id == name:
+                pos = (node.lineno, node.col_offset)
+                if pos > call_end:
+                    is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+                    events.append((pos, is_store, node))
+        events.sort(key=lambda e: e[0])
+        if events and not events[0][1]:  # first later event is a read
+            _, _, read = events[0]
+            violations.append(_violation(
+                "donation-discipline", source, read,
+                f"`{name}` is read after being donated to a jitted call "
+                f"(line {call.lineno}) — a donated buffer is invalidated "
+                "by the call (jax returns garbage or errors); use the "
+                "returned state instead",
+            ))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Rule: trace-purity
+# ---------------------------------------------------------------------------
+
+#: Receiver-segment prefixes that identify the observability plane.
+_OBS_HINTS = ("journal", "registry", "metric", "obs")
+
+
+def _obs_receiver(dotted: str) -> Optional[str]:
+    segments = dotted.split(".")
+    for segment in segments[:-1]:
+        bare = segment.lstrip("_").lower()
+        if any(bare.startswith(hint) for hint in _OBS_HINTS):
+            return segment
+    return None
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and "lock" in name.lower():
+            return name
+    return None
+
+
+def check_trace_purity(source: SourceFile) -> List[Violation]:
+    """No obs/journal calls, file IO, or lock acquisition under trace."""
+    index = traced_index(source)
+    violations: List[Violation] = []
+    for info in index.traced_infos():
+        for node in index.own_body(info):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = _lock_name(item.context_expr)
+                    if lock:
+                        violations.append(_violation(
+                            "trace-purity", source, node,
+                            f"lock `{lock}` acquired {_where(index, info)} "
+                            "— the acquisition runs once at trace time "
+                            "(not per step), guards nothing at runtime, "
+                            "and can deadlock compilation; synchronize "
+                            "on the host side of the jit boundary",
+                        ))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                violations.append(_violation(
+                    "trace-purity", source, node,
+                    f"open(...) {_where(index, info)} — file IO inside a "
+                    "traced function runs at trace time only and is "
+                    "invisible to the compiled step; do IO on the host",
+                ))
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                violations.append(_violation(
+                    "trace-purity", source, node,
+                    f".acquire() {_where(index, info)} — lock acquisition "
+                    "under trace runs once at trace time and guards "
+                    "nothing at runtime; synchronize on the host",
+                ))
+                continue
+            dotted = _dotted(node.func)
+            if dotted:
+                receiver = _obs_receiver(dotted)
+                if receiver:
+                    violations.append(_violation(
+                        "trace-purity", source, node,
+                        f"obs-plane call {dotted}(...) {_where(index, info)}"
+                        " — the metrics/journal plane must never be "
+                        "traced into a step (it would record once at "
+                        "trace time, then never again); emit from the "
+                        "host loop around the jitted call",
+                    ))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Rule: sharding-coverage
+# ---------------------------------------------------------------------------
+
+#: Files on the multi-device path by location; any other file opts in
+#: with a `# multi-device-path` comment.
+_MULTI_DEVICE_PATH_FRAGMENT = "elasticdl_tpu/parallel/"
+_MULTI_DEVICE_MARKER = "multi-device-path"
+
+_SHARDING_KWARGS = (
+    "in_shardings",
+    "out_shardings",
+    "in_axis_resources",
+    "out_axis_resources",
+)
+
+
+def _on_multi_device_path(source: SourceFile) -> bool:
+    normalized = source.path.replace("\\", "/")
+    if _MULTI_DEVICE_PATH_FRAGMENT in normalized:
+        return True
+    return any(
+        _MULTI_DEVICE_MARKER in comment
+        for comment in source.comments.values()
+    )
+
+
+def check_sharding_coverage(source: SourceFile) -> List[Violation]:
+    """Multi-device-path jit calls declare shardings or a mesh context."""
+    if not _on_multi_device_path(source):
+        return []
+    index = traced_index(source)
+    violations: List[Violation] = []
+    for site in index.jit_sites:
+        if any(kwarg in site.keywords for kwarg in _SHARDING_KWARGS):
+            continue
+        if site.in_mesh_context:
+            continue
+        what = (
+            f"compiling `{index.functions[site.target].name}`"
+            if site.target and site.target in index.functions
+            else "call"
+        )
+        violations.append(_violation(
+            "sharding-coverage", source, site.node,
+            f"multi-device-path {site.entry}(...) {what} without "
+            "in_shardings/out_shardings or an enclosing mesh context — "
+            "XLA then guesses the layout (replicating large state or "
+            "inserting resharding collectives); declare the placement "
+            "explicitly (the parallel/compile.py layer, ROADMAP item 3, "
+            "will own these tables)",
+        ))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Registry (merged into rules.ALL_RULES)
+# ---------------------------------------------------------------------------
+
+JAX_RULES = {
+    "jit-host-sync": check_jit_host_sync,
+    "retrace-hazard": check_retrace_hazard,
+    "donation-discipline": check_donation_discipline,
+    "trace-purity": check_trace_purity,
+    "sharding-coverage": check_sharding_coverage,
+}

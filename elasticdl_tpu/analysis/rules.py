@@ -1,9 +1,12 @@
-"""The five invariant checkers (one per control-plane contract).
+"""The control-plane invariant checkers (one per contract).
 
 Each rule is a function ``rule(source: SourceFile) -> List[Violation]``.
 docs/invariants.md tabulates the rules, their rationale (tied to
 docs/failure_model.md), and the suppression syntax; tests/test_analysis.py
-holds the must-pass / must-fail fixture snippets for every rule.
+holds the must-pass / must-fail fixture snippets for every rule.  The
+compute-plane (hot-path) rule family lives in `jax_rules.py` on top of
+the flow-aware tracedness core in `traced.py`; both families merge into
+``ALL_RULES`` below.
 
 Rules
 -----
@@ -597,6 +600,8 @@ def check_metric_label_cardinality(source: SourceFile) -> List[Violation]:
 # Registry
 # ---------------------------------------------------------------------------
 
+from elasticdl_tpu.analysis.jax_rules import JAX_RULES  # noqa: E402
+
 ALL_RULES = {
     "rpc-deadline": check_rpc_deadline,
     "idempotency": check_idempotency,
@@ -604,6 +609,7 @@ ALL_RULES = {
     "thread-hygiene": check_thread_hygiene,
     "lock-discipline": check_lock_discipline,
     "metric-label-cardinality": check_metric_label_cardinality,
+    **JAX_RULES,
 }
 
 RULE_NAMES = tuple(ALL_RULES)

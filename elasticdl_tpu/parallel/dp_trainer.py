@@ -234,7 +234,12 @@ class DataParallelTrainer:
                 # tree is template enough, and the tiny export specs come
                 # from a specs-only jit whose unused param computations
                 # XLA dead-code-eliminates.
-                specs = jax.jit(
+                # Specs-only jit: the outputs are a handful of [2] int32
+                # packed-table specs (host-bound, layout-irrelevant) and
+                # the param computations feeding them are dead-code-
+                # eliminated — declaring shardings here would force the
+                # full init to compile.
+                specs = jax.jit(  # noqa-invariant: sharding-coverage
                     lambda r, f: self._make_state(r, f)[1]
                 )(rng, features)
                 self._state = self._restore_sharded(state_shapes)

@@ -1,12 +1,17 @@
 """Unit tests for the invariant analyzer (elasticdl_tpu.analysis).
 
-One must-pass + must-fail fixture pair per rule, the inline-suppression
-contract, and the two repo-level acceptance gates:
+One must-pass + must-fail fixture pair per rule (control-plane rules in
+rules.py AND the flow-aware hot-path family in jax_rules.py), the
+inline-suppression contract, the JSON/baseline CLI surface, and the
+repo-level acceptance gates:
 
-- the production tree is invariant-clean (`python -m elasticdl_tpu.analysis`
-  exits 0) — this test IS the tier-1 wiring of `make check-invariants`;
-- a seeded violation of each of the five rules makes the CLI exit
-  non-zero.
+- the production tree (elasticdl_tpu/ + model_zoo/) is invariant-clean
+  (`python -m elasticdl_tpu.analysis` exits 0) — this test IS the
+  tier-1 wiring of `make check-invariants`;
+- a seeded violation of every registered rule makes the CLI exit
+  non-zero;
+- tracedness is transitive: a helper called only from a jitted fn is
+  flagged for a planted host sync.
 """
 
 import textwrap
@@ -400,6 +405,304 @@ def test_metric_cardinality_accepts_bounded_labels_and_journal_fields():
 
 
 # ---------------------------------------------------------------------------
+# Hot-path rule family (jax_rules.py, on the traced.py dataflow core)
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_flags_syncs_under_trace():
+    found = violations(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(state, x):
+            loss = float(x)
+            np.asarray(state)
+            print(loss)
+            jax.device_get(x)
+            return x.item()
+        """,
+        "jit-host-sync",
+    )
+    assert len(found) == 5
+    assert any("jax.debug.print" in v.message for v in found)
+
+
+def test_host_sync_is_transitive_through_helpers():
+    """Acceptance: a helper called ONLY from a jitted fn is flagged for a
+    planted host sync (tracedness is transitive, not per-line)."""
+    found = violations(
+        """
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+        """,
+        "jit-host-sync",
+    )
+    assert len(found) == 1 and "helper" in found[0].message
+
+
+def test_host_sync_ignores_host_code_and_static_shape_math():
+    """The same constructs are legal on the host side of the jit
+    boundary, and shape arithmetic is legal UNDER it."""
+    found = violations(
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            b, d = x.shape
+            n = int(np.prod(x.shape))
+            scale = 1.0 / (d ** 0.5)
+            jax.debug.print("n={n}", n=n)
+            return jnp.sum(x) * scale
+
+        def host_loop(step_fn, batches):
+            for batch in batches:
+                loss = step_fn(batch)
+                print(float(np.asarray(loss).item()))
+        """,
+        "jit-host-sync",
+    )
+    assert found == []
+
+
+def test_host_sync_sees_scan_body_and_lambda_roots():
+    found = violations(
+        """
+        import jax
+
+        def run(state, xs):
+            def body(carry, x):
+                carry.item()
+                return carry, x
+            return jax.lax.scan(body, state, xs)
+        """,
+        "jit-host-sync",
+    )
+    assert len(found) == 1
+
+
+def test_retrace_hazard_flags_jit_in_loop_and_per_step_method():
+    found = violations(
+        """
+        import jax
+
+        def run(fn, xs):
+            for x in xs:
+                jax.jit(fn)(x)
+
+        class T:
+            def train_step(self, state, x):
+                return jax.jit(self._impl)(state, x)
+
+            def _impl(self, state, x):
+                return state
+        """,
+        "retrace-hazard",
+    )
+    assert len(found) == 2
+    assert any("loop" in v.message for v in found)
+    assert any("train_step" in v.message for v in found)
+
+
+def test_retrace_hazard_flags_unhashable_static_and_mutable_closure():
+    found = violations(
+        """
+        import jax
+
+        def f(x, opts=[]):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+
+        def make(xs):
+            stats = []
+
+            @jax.jit
+            def step(x):
+                stats.append(1)
+                return x
+
+            return step
+        """,
+        "retrace-hazard",
+    )
+    assert len(found) == 2
+    assert any("opts" in v.message for v in found)
+    assert any("stats" in v.message for v in found)
+
+
+def test_retrace_hazard_accepts_compile_time_construction():
+    found = violations(
+        """
+        import jax
+
+        class T:
+            def __init__(self):
+                self._compile_steps()
+
+            def _compile_steps(self):
+                self._train_step = jax.jit(
+                    self._impl, donate_argnums=(0,)
+                )
+
+            def _impl(self, state, x):
+                return state
+        """,
+        "retrace-hazard",
+    )
+    assert found == []
+
+
+def test_donation_flags_train_step_without_donation():
+    found = violations(
+        """
+        import jax
+
+        class T:
+            def __init__(self):
+                self._train_step = jax.jit(self._train_step_impl)
+
+            def _train_step_impl(self, state, batch):
+                return state
+        """,
+        "donation-discipline",
+    )
+    assert len(found) == 1 and "donate" in found[0].message
+
+
+def test_donation_flags_use_after_donating_call():
+    found = violations(
+        """
+        import jax
+
+        class T:
+            def __init__(self):
+                self._train_step = jax.jit(
+                    self._train_step_impl, donate_argnums=(0,)
+                )
+
+            def _train_step_impl(self, state, batch):
+                return state, 0.0
+
+            def run(self, state, batch):
+                new_state, loss = self._train_step(state, batch)
+                return state
+        """,
+        "donation-discipline",
+    )
+    assert len(found) == 1 and "donated" in found[0].message
+
+
+def test_donation_accepts_donating_steps_and_undonated_eval():
+    found = violations(
+        """
+        import jax
+
+        class T:
+            def __init__(self):
+                self._train_step = jax.jit(
+                    self._train_step_impl, donate_argnums=(0,)
+                )
+                self._eval_step = jax.jit(self._eval_step_impl)
+
+            def _train_step_impl(self, state, batch):
+                return state, 0.0
+
+            def _eval_step_impl(self, state, batch):
+                return batch
+
+            def run(self, state, batch):
+                state, loss = self._train_step(state, batch)
+                return self._eval_step(state, batch)
+        """,
+        "donation-discipline",
+    )
+    assert found == []
+
+
+def test_trace_purity_flags_obs_io_and_locks_under_trace():
+    found = violations(
+        """
+        import jax
+
+        @jax.jit
+        def step(x, journal, registry):
+            journal.record("step", loss=x)
+            registry.counter("steps_total", "h").inc()
+            with STEP_LOCK:
+                y = x + 1
+            open("/tmp/trace.log")
+            return y
+        """,
+        "trace-purity",
+    )
+    assert len(found) == 4
+    assert any("journal" in v.message for v in found)
+    assert any("STEP_LOCK" in v.message for v in found)
+
+
+def test_trace_purity_accepts_host_side_obs():
+    found = violations(
+        """
+        import jax
+
+        @jax.jit
+        def step(state, x):
+            return state, x
+
+        def host_loop(journal, lock, state, batches):
+            for batch in batches:
+                state, loss = step(state, batch)
+                with lock:
+                    journal.record("step", loss=float(loss))
+        """,
+        "trace-purity",
+    )
+    assert found == []
+
+
+def test_sharding_coverage_gates_marked_multi_device_files():
+    text = """
+    # multi-device-path
+    import jax
+
+    def compile_steps(impl, shardings):
+        bare = jax.jit(impl)
+        good = jax.jit(
+            impl, in_shardings=shardings, out_shardings=shardings
+        )
+        with mesh:
+            contextual = jax.jit(impl)
+        return bare, good, contextual
+    """
+    found = violations(text, "sharding-coverage")
+    assert len(found) == 1 and "in_shardings" in found[0].message
+    # Same file without the marker (and off parallel/): out of scope.
+    clean = violations(text.replace("# multi-device-path", ""),
+                       "sharding-coverage")
+    assert clean == []
+
+
+def test_sharding_coverage_applies_to_parallel_tree_by_path():
+    text = "import jax\nstep = jax.jit(lambda x: x + 1)\n"
+    assert violations(text, "sharding-coverage",
+                      path="elasticdl_tpu/parallel/new_trainer.py")
+    assert not violations(text, "sharding-coverage",
+                          path="elasticdl_tpu/worker/new_trainer.py")
+
+
+# ---------------------------------------------------------------------------
 # Suppression
 # ---------------------------------------------------------------------------
 
@@ -417,6 +720,35 @@ def test_noqa_invariant_suppresses_by_rule_and_star():
         "thread-hygiene",
     )
     assert len(found) == 1  # only the wrong-rule suppression still flags
+
+
+def test_noqa_on_def_line_covers_decorator_line_violations():
+    """A suppression on the `def` line also covers violations reported
+    on its decorator lines (decorator-form jit sites anchor there)."""
+    flagged = violations(
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit)
+        def train_step(state, x):
+            return state
+        """,
+        "donation-discipline",
+    )
+    assert len(flagged) == 1  # sanity: the fixture does violate
+    suppressed = violations(
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit)
+        def train_step(state, x):  # noqa-invariant: donation-discipline
+            return state
+        """,
+        "donation-discipline",
+    )
+    assert suppressed == []
 
 
 # ---------------------------------------------------------------------------
@@ -477,6 +809,39 @@ _SEEDED_VIOLATIONS = {
         "    c = obs.counter('t_total', 'h', labelnames=('task_id',))\n"
         "    c.inc(task_id=task.id)\n"
     ),
+    "jit-host-sync": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    print(x)\n"
+        "    return x\n"
+    ),
+    "retrace-hazard": (
+        "import jax\n"
+        "def run(fn, xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(fn)(x)\n"
+    ),
+    "donation-discipline": (
+        "import jax\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._train_step = jax.jit(self._train_step_impl)\n"
+        "    def _train_step_impl(self, state, batch):\n"
+        "        return state\n"
+    ),
+    "trace-purity": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x, journal):\n"
+        "    journal.record('step', loss=x)\n"
+        "    return x\n"
+    ),
+    "sharding-coverage": (
+        "# multi-device-path\n"
+        "import jax\n"
+        "step = jax.jit(lambda x: x + 1)\n"
+    ),
 }
 
 
@@ -533,3 +898,168 @@ def test_list_rules_has_descriptions(capsys):
     for line in capsys.readouterr().out.strip().splitlines():
         rule, _, description = line.partition(":")
         assert description.strip(), f"rule {rule} listed without a description"
+
+
+def test_default_scan_scope_includes_model_zoo():
+    from elasticdl_tpu.analysis.__main__ import default_paths
+
+    paths = default_paths()
+    assert any(p.rstrip("/").endswith("elasticdl_tpu") for p in paths)
+    assert any(p.rstrip("/").endswith("model_zoo") for p in paths)
+
+
+# ---------------------------------------------------------------------------
+# JSON output + baseline allowlist (incremental gating)
+# ---------------------------------------------------------------------------
+
+
+def _planted_host_sync(tmp_path):
+    bad = tmp_path / "planted.py"
+    bad.write_text(_SEEDED_VIOLATIONS["jit-host-sync"])
+    return bad
+
+
+def test_cli_json_format_is_machine_readable(tmp_path, capsys):
+    import json
+
+    bad = _planted_host_sync(tmp_path)
+    rc = analysis_main([str(bad), "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["files_scanned"] == 1
+    assert data["suppressed"] == 0
+    assert set(data["rules"]) == set(RULE_NAMES)
+    (finding,) = data["findings"]
+    assert finding["rule"] == "jit-host-sync"
+    assert finding["path"] == str(bad)
+    assert finding["line"] == 4 and "message" in finding and "col" in finding
+
+
+def test_cli_json_counts_noqa_suppressions(tmp_path, capsys):
+    import json
+
+    bad = tmp_path / "suppressed.py"
+    bad.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    print(x)  # noqa-invariant: jit-host-sync\n"
+        "    return x\n"
+    )
+    rc = analysis_main([str(bad), "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["findings"] == []
+    assert data["suppressed"] == 1
+    assert data["suppressed_by_rule"] == {"jit-host-sync": 1}
+
+
+def test_cli_baseline_allowlists_known_findings(tmp_path, capsys):
+    """A new rule gates incrementally: snapshot today's findings as the
+    baseline, and only NEW findings fail the gate."""
+    import json
+
+    bad = _planted_host_sync(tmp_path)
+    assert analysis_main([str(bad), "--format", "json"]) == 1
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(capsys.readouterr().out)  # the json IS the baseline
+
+    assert analysis_main([str(bad), "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+
+    # A new violation not in the baseline still fails.
+    bad.write_text(
+        _SEEDED_VIOLATIONS["jit-host-sync"]
+        + "\n\n@jax.jit\ndef step2(x):\n    return x.item()\n"
+    )
+    rc = analysis_main([str(bad), "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert ".item()" in out and "print" not in out
+
+
+def test_cli_baseline_unreadable_is_usage_error(tmp_path, capsys):
+    missing = tmp_path / "nope.json"
+    assert analysis_main(["--baseline", str(missing)]) == 2
+    capsys.readouterr()
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("not json")
+    assert analysis_main(["--baseline", str(garbage)]) == 2
+    assert "baseline" in capsys.readouterr().err
+
+
+def test_invariant_report_renders_per_rule_table(tmp_path, capsys):
+    import json
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        import invariant_report
+    finally:
+        sys.path.pop(0)
+
+    bad = _planted_host_sync(tmp_path)
+    analysis_main([str(bad), "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    table = invariant_report.render(data)
+    lines = table.splitlines()
+    assert lines[0].split() == ["rule", "findings", "suppressed"]
+    row = next(l for l in lines if l.startswith("jit-host-sync"))
+    assert row.split() == ["jit-host-sync", "1", "0"]
+    assert any("1 files scanned" in l for l in lines)
+    # Counts alone don't locate anything: the finding's path:line:col
+    # text rides along so `make lint` output stays actionable.
+    assert any(
+        l.startswith(f"{bad}:4:") and "[jit-host-sync]" in l for l in lines
+    )
+
+
+def test_invariant_report_survives_missing_or_invalid_json(tmp_path, capsys):
+    """The analyzer may exit 2 BEFORE writing JSON (usage error): the
+    report chaser must not bury that one-line error under a traceback."""
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        import invariant_report
+    finally:
+        sys.path.pop(0)
+
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert invariant_report.main([str(empty)]) == 0
+    assert "no findings JSON" in capsys.readouterr().out
+    assert invariant_report.main([str(tmp_path / "missing.json")]) == 0
+
+
+def test_cli_baseline_basename_entry_does_not_allowlist_other_dirs(tmp_path):
+    """A bare-basename baseline entry ('trainer.py', no directory) must
+    not suppress violations in every same-named file in the tree."""
+    import json
+
+    for sub in ("a", "b"):
+        d = tmp_path / sub
+        d.mkdir()
+        (d / "trainer.py").write_text(_SEEDED_VIOLATIONS["jit-host-sync"])
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"findings": [{"rule": "jit-host-sync", "path": "trainer.py"}]}
+    ))
+    assert analysis_main(
+        [str(tmp_path), "--baseline", str(baseline)]
+    ) == 1  # both violations survive the bare-basename entry
+    # With the directory component the entry anchors to ONE file.
+    baseline.write_text(json.dumps(
+        {"findings": [{"rule": "jit-host-sync", "path": "a/trainer.py"}]}
+    ))
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = analysis_main(
+            [str(tmp_path), "--baseline", str(baseline), "--format", "json"]
+        )
+    data = json.loads(buf.getvalue())
+    assert rc == 1 and len(data["findings"]) == 1
+    assert data["findings"][0]["path"].endswith("b/trainer.py")
+    assert data["suppressed"] == 1

@@ -273,6 +273,98 @@ def test_rendezvous_stress_runs_clean_under_lockcheck(lockcheck_enabled):
     lockcheck.assert_clean()
 
 
+def test_traced_purity_canary_static_and_runtime_agree(lockcheck_enabled):
+    """Cross-check the STATIC trace-purity rule against the RUNTIME lock
+    detector on one shared scenario: a deliberately impure jitted fn
+    that acquires a lock under trace.
+
+    The static analyzer must flag the source; the runtime detector must
+    observe that the acquisition really happens exactly once — at trace
+    time — and never again on the cached-executable path.  That is the
+    precise failure mode the rule's message describes ("runs once at
+    trace time, guards nothing at runtime")."""
+    import textwrap
+
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.analysis.core import SourceFile
+    from elasticdl_tpu.analysis.rules import ALL_RULES
+
+    # Static half: the analyzer flags the planted impurity.
+    canary_src = textwrap.dedent(
+        """
+        import jax
+
+        @jax.jit
+        def impure_step(x):
+            with STEP_LOCK:
+                return x + 1
+        """
+    )
+    source = SourceFile.parse("purity_canary.py", canary_src)
+    found = ALL_RULES["trace-purity"](source)
+    assert len(found) == 1, found
+    assert "STEP_LOCK" in found[0].message
+    assert "trace time" in found[0].message
+
+    # Runtime half: the same impurity shape with an instrumented lock.
+    step_lock = CheckedLock("canary.step_lock")
+
+    @jax.jit
+    def impure_step(x):
+        with step_lock:
+            return x + 1
+
+    before = lockcheck.report()["acquisitions"]
+    impure_step(jnp.zeros((4,), jnp.float32)).block_until_ready()
+    traced = lockcheck.report()["acquisitions"]
+    assert traced == before + 1, "lock not observed during tracing"
+    impure_step(jnp.ones((4,), jnp.float32)).block_until_ready()
+    assert lockcheck.report()["acquisitions"] == traced, (
+        "cached-executable call re-acquired the lock — tracing semantics "
+        "changed; the static rule's 'once at trace time' claim is stale"
+    )
+    lockcheck.assert_clean()
+
+
+def test_traced_purity_canary_pure_step_is_silent_both_ways(
+    lockcheck_enabled,
+):
+    """The agreeing negative: a pure jitted step trips neither the
+    static rule nor the runtime detector."""
+    import textwrap
+
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.analysis.core import SourceFile
+    from elasticdl_tpu.analysis.rules import ALL_RULES
+
+    pure_src = textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def pure_step(x):
+            return jnp.sum(x * x)
+        """
+    )
+    source = SourceFile.parse("purity_canary_ok.py", pure_src)
+    assert ALL_RULES["trace-purity"](source) == []
+
+    before = lockcheck.report()["acquisitions"]
+
+    @jax.jit
+    def pure_step(x):
+        return jnp.sum(x * x)
+
+    pure_step(jnp.ones((4,), jnp.float32)).block_until_ready()
+    assert lockcheck.report()["acquisitions"] == before
+    lockcheck.assert_clean()
+
+
 def test_lockcheck_distinguishes_same_named_instances(lockcheck_enabled):
     """Two services of the same class share a lock NAME but not identity:
     holding instance A's lock while taking instance B's must not read as
