@@ -47,6 +47,9 @@ lint:
 # (pyproject registers the markers) — what CI and a review session can
 # finish on the 1-core box.  tests/test_analysis.py re-runs the invariant
 # pass inside pytest, so the plain pytest tier-1 command gates on it too.
+# The elastic policy-engine units (tests/test_policy.py: eviction
+# hysteresis + kill budget, amortization math, thrash scale-down, the
+# pod-manager scale-down regression) ride in tests/ here.
 test-fast: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
@@ -66,10 +69,14 @@ test-obs:
 	       --selftest tests/golden_journal.jsonl
 
 # Transient-failure resilience gate: deterministic fault injection
-# (common/faults.py) + the master-SIGKILL / torn-checkpoint chaos e2e.
+# (common/faults.py, incl. the schedule-based @t storm triggers), the
+# master-SIGKILL / torn-checkpoint chaos e2es, the preemption-storm
+# two-baseline e2e (the policy engine must beat fixed-size AND naive
+# always-rescale on the goodput ledger's own accounting), and the
+# policy-enforcement units.
 test-chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
-	       tests/test_faults.py -q
+	       tests/test_faults.py tests/test_policy.py -q
 
 # The real multi-process end-to-end slices only (elasticity, PS, k8s).
 e2e:

@@ -217,6 +217,47 @@ def add_cluster_arguments(parser: argparse.ArgumentParser):
         "--need_elasticity", type=str2bool, nargs="?", const=True, default=True
     )
     parser.add_argument(
+        "--policy_enabled", type=str2bool, nargs="?", const=True,
+        default=True,
+        help="Run the goodput-driven elastic policy engine "
+        "(master/policy.py): scale-up gated on amortizing the measured "
+        "rescale cost, scale-down/hold under rescale thrash, and "
+        "budgeted straggler eviction. False = observe-only (PR-4/5 "
+        "advisory behavior).",
+    )
+    parser.add_argument(
+        "--policy_amortize_horizon_s", type=float, default=600.0,
+        help="Scale-up is approved only when the marginal-throughput "
+        "gain of the granted workers repays the goodput ledger's "
+        "measured per-rescale cost within this many seconds (see "
+        "docs/failure_model.md 'Policy enforcement' for tuning).",
+    )
+    parser.add_argument(
+        "--policy_tick_interval_s", type=float, default=2.0,
+        help="Seconds between policy-engine evaluation ticks.",
+    )
+    parser.add_argument(
+        "--policy_min_workers", type=pos_int, default=1,
+        help="Enforcement floor: no policy decision (eviction or "
+        "scale-down) may shrink the fleet below this.",
+    )
+    parser.add_argument(
+        "--policy_evict_after", type=pos_int, default=3,
+        help="A straggler must stay flagged for this many CONSECUTIVE "
+        "policy ticks before eviction (on top of the detector's own "
+        "hysteresis — one noisy snapshot can never kill a worker).",
+    )
+    parser.add_argument(
+        "--policy_kill_budget", type=non_neg_int, default=1,
+        help="Straggler evictions allowed per budget window; 0 keeps "
+        "the straggler path advisory-only.",
+    )
+    parser.add_argument(
+        "--policy_kill_budget_window_s", type=float, default=600.0,
+        help="Length of the straggler kill-budget window; the budget "
+        "refills when a window elapses.",
+    )
+    parser.add_argument(
         "--worker_liveness_timeout_s", type=non_neg_int, default=60,
         help="Kill+relaunch a worker whose heartbeat is silent this long "
         "(0 disables hung-worker detection)",

@@ -27,15 +27,25 @@ Injection sites wired into the framework:
 Spec grammar (comma/semicolon separated, via `ELASTICDL_FAULTS` or
 `install()`):
 
-    site:kind[=arg][@after][xcount]
+    site:kind[=arg][@after|@tSECONDS][xcount]
 
     rpc.get_task:error=UNAVAILABLE@1x3   calls 1-3 raise UNAVAILABLE
     rpc.get_task:latency=0.25@2          2nd call delayed 0.25 s
     ckpt.write:truncate@2                2nd checkpoint write torn
     worker.task:crash@3                  process exits on 3rd task
+    storm.preempt:crash@t2.5             due once 2.5 s into a schedule
 
 `after` is 1-based (default 1); `count` is how many consecutive calls
 trigger (default 1, `x*` = every call from `after` on).
+
+**Schedule-based triggers** (`@t<seconds>`): the spec fires once, at a
+RELATIVE time on a timeline the *caller* owns — this module never reads
+a clock (determinism).  A driver (e.g. the preemption-storm chaos
+harness) polls `due(site, elapsed_s)` with its own elapsed seconds and
+applies every newly-due spec; `remaining_due(site)` says when the
+schedule is exhausted.  Time specs never trigger through `fire()` and
+never combine with `xcount` (one spec per scheduled firing keeps replay
+exact).
 """
 
 from __future__ import annotations
@@ -60,8 +70,11 @@ class FaultSpec:
     arg: str = ""
     after: int = 1  # first triggering call, 1-based
     count: int = 1  # number of consecutive triggering calls; -1 = forever
+    at_s: Optional[float] = None  # schedule trigger: relative seconds
 
     def triggers_at(self, call_number: int) -> bool:
+        if self.at_s is not None:
+            return False  # schedule specs fire through due(), not fire()
         if call_number < self.after:
             return False
         return self.count < 0 or call_number < self.after + self.count
@@ -71,6 +84,7 @@ class FaultSpec:
 class _Registry:
     specs: List[FaultSpec] = field(default_factory=list)
     counters: Dict[str, int] = field(default_factory=dict)
+    fired_schedule: set = field(default_factory=set)  # spec indices
     lock: threading.Lock = field(default_factory=threading.Lock)
 
 
@@ -88,13 +102,19 @@ def parse_specs(text: str) -> List[FaultSpec]:
         try:
             site, rest = token.split(":", 1)
             count = 1
+            explicit_count = False
             if "x" in rest.rsplit("@", 1)[-1]:
                 rest, count_text = rest.rsplit("x", 1)
                 count = -1 if count_text == "*" else int(count_text)
+                explicit_count = True
             after = 1
+            at_s = None
             if "@" in rest:
                 rest, after_text = rest.rsplit("@", 1)
-                after = int(after_text)
+                if after_text.startswith("t"):
+                    at_s = float(after_text[1:])
+                else:
+                    after = int(after_text)
             kind, _, arg = rest.partition("=")
         except ValueError as exc:
             raise ValueError(f"Unparseable fault spec {token!r}") from exc
@@ -104,8 +124,17 @@ def parse_specs(text: str) -> List[FaultSpec]:
             )
         if after < 1 or (count < 1 and count != -1):
             raise ValueError(f"Bad @after/xcount in fault spec {token!r}")
+        if at_s is not None and (at_s < 0 or explicit_count):
+            raise ValueError(
+                f"Bad schedule trigger in fault spec {token!r}: @t needs "
+                "seconds >= 0 and fires exactly once (no xcount — list "
+                "one spec per firing)"
+            )
         specs.append(
-            FaultSpec(site=site, kind=kind, arg=arg, after=after, count=count)
+            FaultSpec(
+                site=site, kind=kind, arg=arg, after=after, count=count,
+                at_s=at_s,
+            )
         )
     return specs
 
@@ -162,6 +191,43 @@ def fire(site: str) -> Optional[FaultSpec]:
             if spec.site == site and spec.triggers_at(n):
                 return spec
     return None
+
+
+def due(site: str, elapsed_s: float) -> List[FaultSpec]:
+    """Schedule-based triggers: the `@t<seconds>` specs of `site` whose
+    time has come at `elapsed_s` — seconds on the CALLER's timeline
+    (this module never reads a clock; the driver owns schedule start).
+    Each spec is returned exactly once, so a polling driver applies
+    every firing exactly once however often it polls."""
+    registry = _registry
+    if registry is None:
+        return []
+    hits: List[FaultSpec] = []
+    with registry.lock:
+        for index, spec in enumerate(registry.specs):
+            if spec.site != site or spec.at_s is None:
+                continue
+            if spec.at_s <= elapsed_s and index not in registry.fired_schedule:
+                registry.fired_schedule.add(index)
+                hits.append(spec)
+    hits.sort(key=lambda spec: spec.at_s)
+    return hits
+
+
+def remaining_due(site: str) -> int:
+    """How many of `site`'s schedule-based specs have not fired yet —
+    a storm driver's loop-exit condition."""
+    registry = _registry
+    if registry is None:
+        return 0
+    with registry.lock:
+        return sum(
+            1
+            for index, spec in enumerate(registry.specs)
+            if spec.site == site
+            and spec.at_s is not None
+            and index not in registry.fired_schedule
+        )
 
 
 def crash_now(spec: FaultSpec) -> None:

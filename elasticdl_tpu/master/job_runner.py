@@ -83,7 +83,62 @@ def _running_on_k8s(args) -> bool:
     )
 
 
-def _build_worker_manager(args, master, rendezvous, worker_env):
+def _build_policy_engine(args, master):
+    """The goodput-driven policy engine (master/policy.py) — built when
+    elasticity is on and --policy_enabled (the default).  It consumes
+    the goodput ledger, the telemetry aggregator's straggler set, and
+    pod-manager state; its decisions are enforced through the manager
+    (gated scale-up, thrash scale-down, budgeted eviction)."""
+    if not (args.need_elasticity and getattr(args, "policy_enabled", True)):
+        return None
+    from elasticdl_tpu.master.policy import ElasticPolicyEngine, PolicyConfig
+
+    return ElasticPolicyEngine(
+        PolicyConfig.from_args(args),
+        stragglers_fn=(
+            master.telemetry.stragglers if master.telemetry is not None
+            else None
+        ),
+    )
+
+
+class _GatedScaleUp:
+    """Chain policy and capacity: the policy says whether a rescale
+    would pay (amortization, cooldown, thrash — every denial journals a
+    `policy_decision`), and only THEN is the oracle asked whether
+    workers can be had — the k8s probe consumes a once-per-cooldown
+    token per call, which a policy denial must not burn.  Forwards the
+    probe's `failed`/`succeeded` backoff feedback to the wrapped oracle
+    when it has them."""
+
+    def __init__(self, check_fn, policy_engine):
+        self._check_fn = check_fn
+        self._policy_engine = policy_engine
+
+    def __call__(self, needed: int) -> int:
+        return self._policy_engine.gate_scale_up(needed, self._check_fn)
+
+    def failed(self):
+        # The probe behind an APPROVED grant never proved capacity: the
+        # policy retracts its scale_up (cooldown + audit trail) before
+        # the oracle is told to back off.
+        self._policy_engine.scale_up_aborted()
+        if hasattr(self._check_fn, "failed"):
+            self._check_fn.failed()
+
+    def succeeded(self):
+        if hasattr(self._check_fn, "succeeded"):
+            self._check_fn.succeeded()
+
+
+def _gated_scale_up(check_fn, policy_engine):
+    if check_fn is None or policy_engine is None:
+        return check_fn
+    return _GatedScaleUp(check_fn, policy_engine)
+
+
+def _build_worker_manager(args, master, rendezvous, worker_env,
+                          policy_engine=None):
     """Substrate selection: worker pods when this master runs on Kubernetes
     (reference: the master pod creates worker pods through the API server),
     local subprocesses otherwise."""
@@ -131,8 +186,9 @@ def _build_worker_manager(args, master, rendezvous, worker_env):
             owner_pod=owner,
             volume_spec=args.volume,
             tpu_slice=getattr(args, "tpu_slice", ""),
-            scale_up_check_fn=(
-                _K8sCapacityProbe() if args.need_elasticity else None
+            scale_up_check_fn=_gated_scale_up(
+                _K8sCapacityProbe() if args.need_elasticity else None,
+                policy_engine,
             ),
             **common,
         )
@@ -143,8 +199,9 @@ def _build_worker_manager(args, master, rendezvous, worker_env):
             args.checkpoint_dir or tempfile.gettempdir(),
             f"{args.job_name}_worker_logs",
         ),
-        scale_up_check_fn=(
-            _capacity_oracle_from_env() if args.need_elasticity else None
+        scale_up_check_fn=_gated_scale_up(
+            _capacity_oracle_from_env() if args.need_elasticity else None,
+            policy_engine,
         ),
         **common,
     )
@@ -214,13 +271,23 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
         if "=" in pair:
             key, value = pair.split("=", 1)
             worker_env[key.strip()] = value
-    manager = _build_worker_manager(args, master, rendezvous, worker_env)
+    policy_engine = _build_policy_engine(args, master)
+    manager = _build_worker_manager(
+        args, master, rendezvous, worker_env, policy_engine=policy_engine
+    )
     master.pod_manager = manager  # type: ignore[attr-defined]
+    if policy_engine is not None:
+        policy_engine.bind(manager)
     if master.telemetry is not None:
         # Straggler advisories from the telemetry plane flow to the pod
-        # manager (advisory only — see ElasticWorkerManager.note_straggler)
+        # manager (advisory — see ElasticWorkerManager.note_straggler)
         # and to the goodput ledger (training time while flagged is
-        # accounted as degraded_straggler).
+        # accounted as degraded_straggler).  The policy engine consumes
+        # the SAME detector state by polling the aggregator's flagged
+        # set each tick (stragglers_fn, wired in _build_policy_engine) —
+        # one mechanism, not a callback racing the poll — and enforces
+        # eviction of PERSISTENT stragglers under its hysteresis + kill
+        # budget.
         from elasticdl_tpu.obs import goodput
 
         master.telemetry.add_straggler_callback(manager.note_straggler)
@@ -237,6 +304,8 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
     job_succeeded = False
     try:
         manager.start()
+        if policy_engine is not None:
+            policy_engine.start()
         ok = manager.wait()
         if master.evaluation_service is not None:
             master.evaluation_service.finalize()
@@ -253,6 +322,8 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
         job_succeeded = True
         return 0
     finally:
+        if policy_engine is not None:
+            policy_engine.stop()
         manager.stop()
         master.stop()
         if job_succeeded and progress_persister is not None:

@@ -503,6 +503,19 @@ class KubernetesPodManager(ElasticWorkerManager):
         no teardown, no rollback to the last checkpoint, and no restart
         budget (the teardown-first base behavior would burn all three per
         attempt in a capacity-starved cluster)."""
+        with self._resize_lock:
+            with self._lock:
+                if self._stopped or self._handles != handles:
+                    # The world was replaced (a concurrent scale() on the
+                    # policy thread) since this snapshot was polled; probe
+                    # decisions — and especially the commit's
+                    # world-replacement — would act on a stale world.  An
+                    # open probe just stays pending until the next tick
+                    # re-evaluates it against the new world.
+                    return False
+            return self._maybe_scale_up_serialized(handles)
+
+    def _maybe_scale_up_serialized(self, handles: List[PodHandle]) -> bool:
         current = len(handles)
         deficit = self._target_num_workers - current
         if deficit <= 0 or self._scale_up_check_fn is None:

@@ -302,6 +302,16 @@ def main(argv=None):
     if args.distribution_strategy != DistributionStrategy.LOCAL:
         from elasticdl_tpu.master.job_runner import run_allreduce_job, run_ps_job
 
+        if args.need_elasticity and getattr(args, "policy_enabled", True):
+            logger.info(
+                "Elastic policy engine ON (amortize_horizon=%.0fs, "
+                "min_workers=%d, evict_after=%d ticks, kill_budget=%d/"
+                "%.0fs) — --policy_enabled=false for observe-only",
+                args.policy_amortize_horizon_s, args.policy_min_workers,
+                args.policy_evict_after, args.policy_kill_budget,
+                args.policy_kill_budget_window_s,
+            )
+
         runner = (
             run_ps_job
             if args.distribution_strategy

@@ -102,6 +102,14 @@ class ElasticWorkerManager:
         self._scale_up_check_fn = scale_up_check_fn
 
         self._lock = make_lock("ElasticWorkerManager._lock")
+        # Serializes the world-REPLACING paths (scale(), churn
+        # re-formation, elastic regrow): each is a long drain->relaunch
+        # arc that releases _lock mid-flight, and two running
+        # concurrently (the policy thread's scale() racing the monitor's
+        # churn) would double-launch worlds and leak the loser's
+        # processes.  Ordering: _resize_lock is always taken BEFORE
+        # _lock, never the other way.
+        self._resize_lock = make_lock("ElasticWorkerManager._resize_lock")
         self._handles: List = []  # guarded-by: _lock
         self._next_worker_id = 0  # guarded-by: _lock
         self._restarts_used = 0  # guarded-by: _lock
@@ -206,10 +214,12 @@ class ElasticWorkerManager:
         Deliberately does NOT kill: a straggler is making progress —
         killing it restarts the whole world and replays its in-flight
         work, usually worse than riding out the slowness.  The advisory
-        is recorded (counter + log + `current_straggler_ids`) so
-        operators and future scheduling policies can act on it; genuine
+        is recorded (counter + log + `current_straggler_ids`); genuine
         hangs are still converted to churn by the liveness-timeout kill
-        (_kill_stale_workers)."""
+        (_kill_stale_workers), and PERSISTENT stragglers are evicted by
+        the policy engine (master/policy.py) through its own hysteresis
+        and kill budget — `kill_worker` is the shared mechanism, the
+        budget lives with the policy."""
         with self._lock:
             if flagged:
                 self._straggler_ids.add(worker_id)
@@ -243,28 +253,59 @@ class ElasticWorkerManager:
         # DELETE that must not stall the monitor loop's lock acquisitions.
         self._substrate_kill(target, sig)
 
+    def set_target_num_workers(self, num_workers: int):
+        """Adjust the size the elastic manager is trying to reach WITHOUT
+        forcing a rescale now: the monitor's `_maybe_scale_up` grows
+        toward the new target as the capacity oracle (and the policy
+        gate, when one is wired) allows.  The policy engine uses this to
+        restore a storm-parked fleet once thrash clears."""
+        with self._lock:
+            self._target_num_workers = max(1, int(num_workers))
+
+    def target_num_workers(self) -> int:
+        with self._lock:
+            return self._target_num_workers
+
     def scale(self, num_workers: int):
-        """Explicit elastic resize: tear down and relaunch at the new size."""
-        with self._lock:
-            if self._stopped:
-                return
-            handles = list(self._handles)
-            self._handles = []
-        logger.info("Scaling world to %d workers", num_workers)
-        goodput.ledger().on_rescale_detected("scale", len(handles))
-        self._recover_world_tasks(handles)
-        self._substrate_terminate(handles)
-        goodput.ledger().on_drain_complete(num_workers)
-        with self._lock:
-            # scale() is an external-caller entry point racing the monitor
-            # thread's churn/regrow writes to the same sizing fields.
-            self._num_workers = num_workers
-            self._target_num_workers = max(self._target_num_workers, num_workers)
-        self._m_relaunches.inc(num_workers, reason="scale")
-        obs.journal().record(
-            "scale", old_size=len(handles), new_size=num_workers
-        )
-        self._launch_world(num_workers)
+        """Explicit elastic resize: graceful drain (recover in-flight
+        tasks, tear the old world down), then relaunch at the new size.
+        Scale-DOWN lowers `_target_num_workers` too — the former
+        `max()` clamp kept the old target, so `_maybe_scale_up` would
+        immediately regrow and the shrink was silently a no-op."""
+        if num_workers < 1:
+            raise ValueError(f"scale() needs >= 1 worker, got {num_workers}")
+        with self._resize_lock:
+            with self._lock:
+                if self._stopped:
+                    return
+                handles = list(self._handles)
+                self._handles = []
+            direction = (
+                "up" if num_workers > len(handles)
+                else "down" if num_workers < len(handles)
+                else "flat"
+            )
+            logger.info(
+                "Scaling world %d -> %d workers (%s)",
+                len(handles), num_workers, direction,
+            )
+            goodput.ledger().on_rescale_detected("scale", len(handles))
+            self._recover_world_tasks(handles)
+            self._substrate_terminate(handles)
+            goodput.ledger().on_drain_complete(num_workers)
+            with self._lock:
+                # scale() is an external-caller entry point racing the
+                # monitor thread's churn/regrow writes to these fields.
+                self._num_workers = num_workers
+                self._target_num_workers = num_workers
+            self._m_relaunches.inc(num_workers, reason="scale")
+            obs.journal().record(
+                "scale",
+                old_size=len(handles),
+                new_size=num_workers,
+                direction=direction,
+            )
+            self._launch_world(num_workers)
 
     # ------------------------------------------------------------------
     # Internals
@@ -393,35 +434,52 @@ class ElasticWorkerManager:
             return False
         if self._job_finished():
             return False
-        grant = self._scale_up_check_fn(self._target_num_workers - current)
-        if grant <= 0:
-            return False
-        new_size = min(self._target_num_workers, current + grant)
-        logger.info(
-            "Capacity returned: growing world %d -> %d workers",
-            current,
-            new_size,
-        )
-        with self._lock:
-            if self._stopped:
-                return True
-            self._handles = []
-            self._num_workers = new_size
-        # Counted only once the regrow is actually committed (a stop()
-        # racing the grant above must not journal a phantom rescale).
-        self._m_relaunches.inc(new_size, reason="scale_up")
-        obs.journal().record(
-            "scale_up", old_size=current, new_size=new_size
-        )
-        goodput.ledger().on_rescale_detected("scale_up", current)
-        self._recover_world_tasks(handles)
-        self._substrate_terminate(handles)
-        goodput.ledger().on_drain_complete(new_size)
-        self._launch_world(new_size)
-        return True
+        with self._resize_lock:
+            with self._lock:
+                if self._stopped or self._handles != handles:
+                    # The world was replaced (a concurrent scale() on the
+                    # policy thread) since this snapshot was polled; the
+                    # next monitor tick re-evaluates against the new one.
+                    return False
+            grant = self._scale_up_check_fn(self._target_num_workers - current)
+            if grant <= 0:
+                return False
+            new_size = min(self._target_num_workers, current + grant)
+            logger.info(
+                "Capacity returned: growing world %d -> %d workers",
+                current,
+                new_size,
+            )
+            with self._lock:
+                if self._stopped:
+                    return True
+                self._handles = []
+                self._num_workers = new_size
+            # Counted only once the regrow is actually committed (a stop()
+            # racing the grant above must not journal a phantom rescale).
+            self._m_relaunches.inc(new_size, reason="scale_up")
+            obs.journal().record(
+                "scale_up", old_size=current, new_size=new_size
+            )
+            goodput.ledger().on_rescale_detected("scale_up", current)
+            self._recover_world_tasks(handles)
+            self._substrate_terminate(handles)
+            goodput.ledger().on_drain_complete(new_size)
+            self._launch_world(new_size)
+            return True
 
     def _handle_churn(self, handles: List, crashed):
         """One churn event: any worker death invalidates the whole world."""
+        with self._resize_lock:
+            with self._lock:
+                if self._stopped or self._handles != handles:
+                    # The world was replaced (a concurrent scale() on the
+                    # policy thread already drained these processes);
+                    # their exits are expected teardown, not churn.
+                    return
+            self._handle_churn_serialized(handles, crashed)
+
+    def _handle_churn_serialized(self, handles: List, crashed):
         for h, code in crashed:
             logger.warning(
                 "%s died (exit %s) — world re-formation",

@@ -110,6 +110,7 @@ class GoodputLedger:
         self._straggler_ids: set = set()  # guarded-by: _lock
         self._rescale: Optional[dict] = None  # guarded-by: _lock
         self._rescale_seq = 0  # guarded-by: _lock
+        self._last_emitted: Optional[dict] = None  # guarded-by: _lock
         self._finished = False  # guarded-by: _lock
 
         self._m_phase_seconds = obs.counter(
@@ -399,7 +400,31 @@ class GoodputLedger:
         rescale["superseded"] = superseded
         return rescale
 
+    def last_rescale(self) -> Optional[dict]:
+        """The most recently COMPLETED rescale's cost record (the value
+        behind elasticdl_goodput_last_rescale_seconds), with `t_end` —
+        the ledger clock when it closed.  None before the first one.
+        The policy engine prices scale decisions off this."""
+        with self._lock:
+            return dict(self._last_emitted) if self._last_emitted else None
+
+    def seconds_since_last_rescale(self) -> Optional[float]:
+        """Seconds since the last completed rescale closed (the policy
+        engine's cooldown clock); None before any rescale completed."""
+        with self._lock:
+            if self._last_emitted is None:
+                return None
+            return max(0.0, self._clock() - self._last_emitted["t_end"])
+
+    def rescale_in_flight(self) -> bool:
+        """True while a rescale record is open (detection happened, redo
+        not yet repaid) — scale decisions should wait it out."""
+        with self._lock:
+            return self._rescale is not None
+
     def _emit_rescale(self, rescale: dict):
+        with self._lock:
+            self._last_emitted = {**rescale, "t_end": self._clock()}
         for component in ("detection", "rendezvous", "redo", "total"):
             self._m_rescale_cost.observe(
                 rescale[f"{component}_s"], component=component

@@ -103,6 +103,24 @@ def goodput_header(text: str) -> str:
     return "  ".join(bits)
 
 
+def policy_header(events: List[dict]) -> str:
+    """The most recent `policy_decision` in the journal tail, for the
+    header — or "" against masters that predate the policy engine (old
+    masters emit no such events; degrade, never raise)."""
+    last = None
+    for event in events:
+        if event.get("event") == "policy_decision":
+            last = event
+    if not isinstance(last, dict) or not last.get("action"):
+        return ""
+    text = f"policy={last['action']}"
+    if last.get("reason"):
+        text += f"({last['reason']})"
+    if last["action"] == "evict" and last.get("worker_id") is not None:
+        text += f" worker={last['worker_id']}"
+    return text
+
+
 def worker_rows(
     events: List[dict], now: Optional[float] = None
 ) -> List[dict]:
@@ -220,11 +238,16 @@ def snapshot_frame(addr: str, tail: int = 256) -> str:
         events = journal.get("events", [])
     except (urllib.error.URLError, OSError, ValueError) as exc:
         notes.append(f"(journal endpoint unavailable: {exc})")
+    job_header = "  ".join(
+        part
+        for part in (goodput_header(metrics_text), policy_header(events))
+        if part
+    )
     return render(
         worker_rows(events),
         parse_metrics(metrics_text),
         addr,
-        job_header=goodput_header(metrics_text),
+        job_header=job_header,
         notes=notes,
     )
 

@@ -52,6 +52,9 @@ EVENT_REQUIRED_FIELDS = {
         "cause", "total_s", "detection_s", "rendezvous_s", "redo_s",
     ),
     "goodput_summary": ("goodput_ratio", "wall_s", "phases"),
+    # Elastic policy engine (master/policy.py — docs/observability.md
+    # "Policy decisions"): scale_up/scale_down/evict/hold + evidence.
+    "policy_decision": ("action", "reason"),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -198,10 +201,14 @@ def _selftest() -> int:
          "rendezvous_s": 1.5, "redo_s": 1.0, "redo_records": 64},
         {"ts": 6.6, "event": "goodput_summary", "goodput_ratio": 0.87,
          "wall_s": 41.0, "phases": {"training": 35.7}},
+        {"ts": 6.8, "event": "policy_decision", "action": "evict",
+         "reason": "persistent_straggler", "worker_id": 1,
+         "flag_streak_ticks": 3, "kill_budget_remaining": 0},
         {"ts": 7.0, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
         '{"ts": 1.0, "event": "task_requeue"}',        # missing reason
+        '{"ts": 1.2, "event": "policy_decision", "action": "hold"}',  # no reason
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

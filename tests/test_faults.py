@@ -95,6 +95,61 @@ def test_reinstall_resets_counters():
 
 
 # ---------------------------------------------------------------------------
+# Schedule-based triggers (@t<seconds>): the preemption-storm primitive
+# ---------------------------------------------------------------------------
+
+
+def test_parse_schedule_trigger():
+    (spec,) = faults.parse_specs("storm.preempt:crash@t2.5")
+    assert spec.site == "storm.preempt"
+    assert spec.kind == "crash"
+    assert spec.at_s == 2.5
+    assert spec.triggers_at(1) is False  # never via the call-count path
+
+
+@pytest.mark.parametrize(
+    "bad", ["s:crash@t-1", "s:crash@tx", "s:crash@t1.5x2", "s:crash@t2x*"]
+)
+def test_parse_rejects_bad_schedule_specs(bad):
+    with pytest.raises(ValueError):
+        faults.parse_specs(bad)
+
+
+def test_due_fires_each_schedule_spec_exactly_once():
+    faults.install(
+        "storm.preempt:crash@t1.0, storm.preempt:crash@t2.0, other:crash@t1.0"
+    )
+    assert faults.remaining_due("storm.preempt") == 2
+    assert faults.due("storm.preempt", 0.5) == []
+    (first,) = faults.due("storm.preempt", 1.5)
+    assert first.at_s == 1.0
+    # Re-polling the same elapsed time must not re-fire it.
+    assert faults.due("storm.preempt", 1.5) == []
+    assert faults.remaining_due("storm.preempt") == 1
+    # A late poll returns everything newly due, oldest first.
+    hits = faults.due("storm.preempt", 10.0)
+    assert [spec.at_s for spec in hits] == [2.0]
+    assert faults.remaining_due("storm.preempt") == 0
+    # Other sites' schedules are independent.
+    assert faults.remaining_due("other") == 1
+
+
+def test_due_and_fire_are_independent_paths():
+    faults.install("s:error@1, s:crash@t0.0")
+    # fire() sees only the call-count spec...
+    assert faults.fire("s").kind == "error"
+    # ...and due() only the schedule spec.
+    (hit,) = faults.due("s", 0.0)
+    assert hit.kind == "crash"
+    assert faults.due("s", 99.0) == []
+
+
+def test_due_disarmed_registry_is_empty():
+    assert faults.due("anything", 100.0) == []
+    assert faults.remaining_due("anything") == 0
+
+
+# ---------------------------------------------------------------------------
 # Integrity manifest helpers
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,623 @@
+"""Policy-engine internals (master/policy.py): eviction hysteresis,
+kill-budget exhaustion/refill, amortization math against synthetic
+ledger costs, the min-workers floor, thrash scale-down + target restore,
+and the pod manager's scale-down regression (the old `max()` clamp made
+lowering the target a silent no-op).
+
+The two-baseline preemption-storm e2e lives in tests/test_chaos.py."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.master.policy import (
+    ElasticPolicyEngine,
+    PolicyConfig,
+)
+from elasticdl_tpu.obs import goodput
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_journal",
+        os.path.join(REPO_ROOT, "scripts", "validate_journal.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class FakeLedger:
+    """Synthetic goodput-ledger surface the engine consumes."""
+
+    def __init__(self):
+        self.seconds = {p: 0.0 for p in goodput.PHASES}
+        self.rescales = 0
+        self.last = None
+        self.since = None
+        self.in_flight = False
+
+    def phase_seconds(self):
+        return dict(self.seconds)
+
+    def counts(self):
+        return {
+            "records_done": 0, "records_redone": 0,
+            "redo_pending": 0, "rescales": self.rescales,
+        }
+
+    def last_rescale(self):
+        return dict(self.last) if self.last else None
+
+    def seconds_since_last_rescale(self):
+        return self.since
+
+    def rescale_in_flight(self):
+        return self.in_flight
+
+
+class FakeManager:
+    """Manager surface: world membership + the two enforcement calls."""
+
+    def __init__(self, ids):
+        self.ids = list(ids)
+        self.kills = []
+        self.scales = []
+        self.target = len(self.ids)
+
+    def current_worker_ids(self):
+        return list(self.ids)
+
+    def kill_worker(self, worker_id, sig=9):
+        if worker_id not in self.ids:
+            raise ValueError(f"No live worker {worker_id}")
+        self.kills.append((worker_id, sig))
+        self.ids.remove(worker_id)
+
+    def scale(self, n):
+        self.scales.append(n)
+        self.ids = list(range(100, 100 + n))
+        self.target = n
+
+    def set_target_num_workers(self, n):
+        self.target = n
+
+    def target_num_workers(self):
+        return self.target
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = obs.init_journal(str(tmp_path))
+    try:
+        yield path
+    finally:
+        obs.journal().configure(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _engine(config, manager=None, ledger=None, clock=None):
+    return ElasticPolicyEngine(
+        config,
+        manager=manager,
+        ledger=ledger or FakeLedger(),
+        clock=clock or FakeClock(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# (c) Eviction: hysteresis, budget, floor
+# ---------------------------------------------------------------------------
+
+
+def test_eviction_needs_consecutive_flag_ticks(
+    journal_file, obs_registry_snapshot
+):
+    clock = FakeClock()
+    manager = FakeManager([0, 1, 2, 3])
+    engine = _engine(
+        PolicyConfig(evict_after_ticks=3, kill_budget=5, min_workers=1),
+        manager=manager, clock=clock,
+    )
+    engine.note_straggler(2, True, {"metric": "step_time", "value": 0.9})
+    for _ in range(2):
+        engine.tick(clock.advance(1.0))
+        assert manager.kills == []  # hysteresis: not yet
+    decisions = engine.tick(clock.advance(1.0))
+    assert manager.kills == [(2, 9)]
+    (evict,) = [d for d in decisions if d["action"] == "evict"]
+    assert evict["reason"] == "persistent_straggler"
+    assert evict["worker_id"] == 2
+    assert evict["flag_streak_ticks"] == 3
+    assert evict["straggler_evidence"]["metric"] == "step_time"
+    journaled = [
+        e for e in _events(journal_file)
+        if e["event"] == "policy_decision" and e["action"] == "evict"
+    ]
+    assert len(journaled) == 1 and journaled[0]["worker_id"] == 2
+
+
+def test_single_noisy_flag_never_kills(journal_file, obs_registry_snapshot):
+    """A flag that clears before the streak completes (one noisy
+    snapshot, detector-cleared) resets the streak — no kill, ever."""
+    clock = FakeClock()
+    manager = FakeManager([0, 1, 2])
+    engine = _engine(
+        PolicyConfig(evict_after_ticks=2, kill_budget=5),
+        manager=manager, clock=clock,
+    )
+    engine.note_straggler(1, True)
+    engine.tick(clock.advance(1.0))
+    engine.note_straggler(1, False)  # cleared: streak must reset
+    for _ in range(5):
+        engine.tick(clock.advance(1.0))
+    engine.note_straggler(1, True)  # re-flagged: needs a FRESH streak
+    engine.tick(clock.advance(1.0))
+    assert manager.kills == []
+    engine.tick(clock.advance(1.0))
+    assert manager.kills == [(1, 9)]
+
+
+def test_kill_budget_exhaustion_and_refill(
+    journal_file, obs_registry_snapshot
+):
+    clock = FakeClock()
+    manager = FakeManager([0, 1, 2, 3, 4])
+    engine = _engine(
+        PolicyConfig(
+            evict_after_ticks=1, kill_budget=1, kill_budget_window_s=100.0,
+        ),
+        manager=manager, clock=clock,
+    )
+    engine.note_straggler(1, True)
+    engine.note_straggler(3, True)
+    decisions = engine.tick(clock.advance(1.0))
+    # Budget 1: exactly one kill; the second falls back to advisory-only.
+    assert manager.kills == [(1, 9)]
+    assert engine.kill_budget_remaining() == 0
+    holds = [d for d in decisions if d["action"] == "hold"]
+    assert [h["reason"] for h in holds] == ["kill_budget_exhausted"]
+    assert holds[0]["worker_id"] == 3
+    # Still flagged through the window: no more kills...
+    for _ in range(3):
+        engine.tick(clock.advance(1.0))
+    assert len(manager.kills) == 1
+    # ...until the window elapses and the budget refills.
+    clock.advance(100.0)
+    assert engine.kill_budget_remaining() == 1
+    engine.tick(clock.t)
+    assert manager.kills == [(1, 9), (3, 9)]
+
+
+def test_zero_budget_is_advisory_only(journal_file, obs_registry_snapshot):
+    clock = FakeClock()
+    manager = FakeManager([0, 1, 2])
+    engine = _engine(
+        PolicyConfig(evict_after_ticks=1, kill_budget=0),
+        manager=manager, clock=clock,
+    )
+    engine.note_straggler(1, True)
+    decisions = engine.tick(clock.advance(1.0))
+    assert manager.kills == []
+    assert [d["reason"] for d in decisions] == ["kill_budget_exhausted"]
+
+
+def test_min_workers_floor_blocks_eviction(
+    journal_file, obs_registry_snapshot
+):
+    clock = FakeClock()
+    manager = FakeManager([0, 1])
+    engine = _engine(
+        PolicyConfig(evict_after_ticks=1, kill_budget=5, min_workers=2),
+        manager=manager, clock=clock,
+    )
+    engine.note_straggler(1, True)
+    decisions = engine.tick(clock.advance(1.0))
+    assert manager.kills == []
+    (hold,) = decisions
+    assert hold["action"] == "hold"
+    assert hold["reason"] == "min_workers_floor"
+    assert hold["worker_id"] == 1
+
+
+# ---------------------------------------------------------------------------
+# (a) Scale-up gate: amortization math, cooldown, in-flight
+# ---------------------------------------------------------------------------
+
+
+def test_amortization_math_against_synthetic_costs(
+    journal_file, obs_registry_snapshot
+):
+    """n=2 workers, k=2 granted, measured cost C=100s: required horizon
+    is C*(n+k)/k = 200s.  H=150 denies, H=250 approves."""
+    ledger = FakeLedger()
+    ledger.last = {"total_s": 100.0, "t_end": 0.0, "cause": "worker_churn"}
+    ledger.since = 1000.0  # far past any cooldown
+    manager = FakeManager([0, 1])
+    denied = _engine(
+        PolicyConfig(amortize_horizon_s=150.0),
+        manager=manager, ledger=ledger,
+    )
+    assert denied.gate_scale_up(2, 2) == 0
+    approved = _engine(
+        PolicyConfig(amortize_horizon_s=250.0),
+        manager=manager, ledger=ledger,
+    )
+    assert approved.gate_scale_up(2, 2) == 2
+    events = [
+        e for e in _events(journal_file) if e["event"] == "policy_decision"
+    ]
+    assert [e["action"] for e in events] == ["hold", "scale_up"]
+    assert events[0]["reason"] == "unamortized_rescale_cost"
+    assert events[0]["required_horizon_s"] == pytest.approx(200.0)
+    assert events[1]["reason"] == "amortized"
+    assert events[1]["last_rescale_cost_s"] == pytest.approx(100.0)
+
+
+def test_unpriced_fleet_scales_up_optimistically(
+    journal_file, obs_registry_snapshot
+):
+    """No completed rescale yet -> no measured cost -> approve (the
+    first rescale is how the price gets measured)."""
+    engine = _engine(PolicyConfig(), manager=FakeManager([0]))
+    assert engine.gate_scale_up(3, 3) == 3
+
+
+def test_cooldown_keyed_off_last_rescale_cost(
+    journal_file, obs_registry_snapshot
+):
+    ledger = FakeLedger()
+    ledger.last = {"total_s": 20.0, "t_end": 0.0, "cause": "scale_up"}
+    engine = _engine(
+        PolicyConfig(
+            cooldown_factor=4.0, min_cooldown_s=30.0,
+            amortize_horizon_s=3600.0,
+        ),
+        manager=FakeManager([0, 1]), ledger=ledger,
+    )
+    # cooldown = max(30, 4*20) = 80s
+    ledger.since = 79.0
+    assert engine.gate_scale_up(1, 1) == 0
+    events = _events(journal_file)
+    assert events[-1]["action"] == "hold"
+    assert events[-1]["reason"] == "cooldown"
+    assert events[-1]["cooldown_s"] == pytest.approx(80.0)
+    ledger.since = 81.0
+    assert engine.gate_scale_up(1, 1) == 1
+
+
+def test_gate_denies_while_rescale_in_flight(
+    journal_file, obs_registry_snapshot
+):
+    ledger = FakeLedger()
+    ledger.in_flight = True
+    engine = _engine(PolicyConfig(), manager=FakeManager([0]), ledger=ledger)
+    assert engine.gate_scale_up(1, 1) == 0
+    assert engine.gate_scale_up(1, 0) == 0  # no grant: no decision at all
+    events = [
+        e for e in _events(journal_file) if e["event"] == "policy_decision"
+    ]
+    assert [e["reason"] for e in events] == ["rescale_in_flight"]
+
+
+# ---------------------------------------------------------------------------
+# (b) Thrash: hold, park at the floor, restore after quiet
+# ---------------------------------------------------------------------------
+
+
+def _thrash_engine(manager, ledger, clock, **overrides):
+    config = dict(
+        thrash_window_s=60.0, thrash_rescales=2, thrash_overhead_frac=0.2,
+        scale_down_after=2, min_cooldown_s=5.0, cooldown_factor=1.0,
+        min_workers=1, amortize_horizon_s=3600.0,
+    )
+    config.update(overrides)
+    return _engine(
+        PolicyConfig(**config), manager=manager, ledger=ledger, clock=clock
+    )
+
+
+def _storm_ledger_step(ledger, training=5.0, overhead=0.0, rescales=0):
+    ledger.seconds["training"] += training
+    ledger.seconds["rendezvous"] += overhead
+    ledger.rescales += rescales
+
+
+def test_thrash_scale_down_parks_at_floor_then_restores(
+    journal_file, obs_registry_snapshot
+):
+    clock = FakeClock()
+    ledger = FakeLedger()
+    manager = FakeManager([0, 1, 2])
+    engine = _thrash_engine(manager, ledger, clock)
+
+    # Quiet baseline tick.
+    _storm_ledger_step(ledger, training=5.0)
+    engine.tick(clock.advance(1.0))
+    assert engine.gate_scale_up(1, 1) == 1  # healthy: grants flow
+
+    # Storm: two rescales land, overhead dominates the window.
+    _storm_ledger_step(ledger, training=1.0, overhead=4.0, rescales=2)
+    ledger.last = {"total_s": 2.0, "t_end": 0.0, "cause": "worker_churn"}
+    ledger.since = 0.5
+    decisions = engine.tick(clock.advance(1.0))  # thrash strike 1
+    assert manager.scales == []
+    assert any(
+        d["action"] == "hold" and d["reason"] == "rescale_thrash"
+        for d in decisions
+    )
+    assert engine.gate_scale_up(1, 1) == 0  # thrash suppresses scale-up
+
+    _storm_ledger_step(ledger, training=1.0, overhead=3.0, rescales=1)
+    # Past the policy's own post-scale-action cooldown (the healthy
+    # grant above counts as a scale action too).
+    decisions = engine.tick(clock.advance(6.0))  # strike 2 -> enforce
+    (down,) = [d for d in decisions if d["action"] == "scale_down"]
+    assert down["reason"] == "rescale_thrash"
+    assert down["old_size"] == 3 and down["new_size"] == 1
+    assert manager.scales == [1]
+    assert len(manager.current_worker_ids()) == 1
+
+    # Storm over: window drains, cooldown passes -> target restored.
+    ledger.since = 100.0
+    clock.advance(120.0)
+    _storm_ledger_step(ledger, training=120.0)
+    decisions = engine.tick(clock.t)  # window slid clean; thrash clears
+    decisions += engine.tick(clock.advance(1.0))
+    restored = [d for d in decisions if d["reason"] == "target_restored"]
+    assert restored and restored[0]["restored_target"] == 3
+    assert manager.target == 3
+    # The actual growth then flows back through the gate.
+    assert engine.gate_scale_up(2, 2) == 2
+
+
+def test_scale_down_waits_out_inflight_rescale(
+    journal_file, obs_registry_snapshot
+):
+    clock = FakeClock()
+    ledger = FakeLedger()
+    manager = FakeManager([0, 1, 2])
+    engine = _thrash_engine(manager, ledger, clock)
+    _storm_ledger_step(ledger, training=5.0)
+    engine.tick(clock.advance(1.0))
+    _storm_ledger_step(ledger, training=1.0, overhead=4.0, rescales=2)
+    ledger.in_flight = True
+    for _ in range(4):  # strikes accumulate but enforcement waits
+        _storm_ledger_step(ledger, training=0.5, overhead=1.0, rescales=1)
+        engine.tick(clock.advance(1.0))
+    assert manager.scales == []
+    ledger.in_flight = False
+    _storm_ledger_step(ledger, training=0.5, overhead=1.0, rescales=1)
+    engine.tick(clock.advance(1.0))
+    assert manager.scales == [1]
+
+
+def test_hold_journal_dedup(journal_file, obs_registry_snapshot):
+    clock = FakeClock()
+    engine = _engine(
+        PolicyConfig(hold_journal_interval_s=30.0),
+        manager=FakeManager([0, 1]), clock=clock,
+    )
+    for _ in range(10):
+        engine.tick(clock.advance(1.0))
+    holds = [
+        e for e in _events(journal_file)
+        if e["event"] == "policy_decision" and e["action"] == "hold"
+    ]
+    assert len(holds) == 1  # identical consecutive holds dedup...
+    clock.advance(31.0)
+    engine.tick(clock.t)
+    holds = [
+        e for e in _events(journal_file)
+        if e["event"] == "policy_decision" and e["action"] == "hold"
+    ]
+    assert len(holds) == 2  # ...to one per interval
+
+
+def test_policy_decisions_pass_schema_validation(
+    journal_file, obs_registry_snapshot
+):
+    clock = FakeClock()
+    ledger = FakeLedger()
+    ledger.last = {"total_s": 50.0, "t_end": 0.0, "cause": "scale"}
+    ledger.since = 1000.0
+    manager = FakeManager([0, 1, 2])
+    engine = _engine(
+        PolicyConfig(evict_after_ticks=1, kill_budget=1,
+                     amortize_horizon_s=10.0),
+        manager=manager, ledger=ledger, clock=clock,
+    )
+    engine.note_straggler(1, True)
+    engine.tick(clock.advance(1.0))          # evict
+    engine.gate_scale_up(1, 1)               # unamortized hold
+    validator = _load_validator()
+    assert validator.validate_file(journal_file) == []
+    events = [
+        e for e in _events(journal_file) if e["event"] == "policy_decision"
+    ]
+    assert {e["action"] for e in events} == {"evict", "hold"}
+
+
+def test_gated_scale_up_wrapper_chains_and_forwards(
+    journal_file, obs_registry_snapshot
+):
+    """job_runner's oracle wrapper: grant flows oracle -> policy gate,
+    and the k8s probe's backoff feedback passes through."""
+    from elasticdl_tpu.master.job_runner import _gated_scale_up
+
+    engine = _engine(PolicyConfig(), manager=FakeManager([0]))
+    assert _gated_scale_up(None, engine) is None
+    plain = lambda needed: needed  # noqa: E731
+    assert _gated_scale_up(plain, None) is plain
+
+    class Probe:
+        def __init__(self):
+            self.calls = []
+
+        def __call__(self, needed):
+            return min(needed, 1)
+
+        def failed(self):
+            self.calls.append("failed")
+
+        def succeeded(self):
+            self.calls.append("succeeded")
+
+    probe = Probe()
+    gated = _gated_scale_up(probe, engine)
+    assert gated(3) == 1  # oracle capped the grant; unpriced gate approves
+    gated.failed()
+    gated.succeeded()
+    assert probe.calls == ["failed", "succeeded"]
+
+
+def test_config_from_args_maps_flags():
+    from elasticdl_tpu.common.args import parse_master_args
+
+    args = parse_master_args([
+        "--model_zoo=model_zoo", "--model_def=m.m",
+        "--policy_amortize_horizon_s=123.5", "--policy_min_workers=2",
+        "--policy_evict_after=7", "--policy_kill_budget=4",
+        "--policy_kill_budget_window_s=55", "--policy_enabled=false",
+    ])
+    config = PolicyConfig.from_args(args)
+    assert config.amortize_horizon_s == 123.5
+    assert config.min_workers == 2
+    assert config.evict_after_ticks == 7
+    assert config.kill_budget == 4
+    assert config.kill_budget_window_s == 55.0
+    # On/off lives with the caller (job_runner reads args.policy_enabled
+    # and simply doesn't build an engine), not inside PolicyConfig.
+    assert args.policy_enabled is False
+
+
+# ---------------------------------------------------------------------------
+# obs.top header: last policy decision, degrading against old masters
+# ---------------------------------------------------------------------------
+
+
+def test_top_header_shows_last_policy_decision():
+    from elasticdl_tpu.obs import top
+
+    events = [
+        {"event": "policy_decision", "action": "hold", "reason": "steady"},
+        {"event": "worker_telemetry", "worker_id": 0},
+        {"event": "policy_decision", "action": "evict",
+         "reason": "persistent_straggler", "worker_id": 3},
+    ]
+    assert top.policy_header(events) == (
+        "policy=evict(persistent_straggler) worker=3"
+    )
+    # Old masters journal no policy_decision events: degrade to nothing.
+    assert top.policy_header([]) == ""
+    assert top.policy_header([{"event": "worker_telemetry"}]) == ""
+    # Malformed tails (journal corruption) degrade too, never raise.
+    assert top.policy_header([{"event": "policy_decision"}]) == ""
+    frame = top.render(
+        [], {"elasticdl_world_size": 3}, addr="x:1",
+        job_header="goodput=97.2%  " + top.policy_header(events),
+    )
+    assert "policy=evict(persistent_straggler)" in frame
+
+
+# ---------------------------------------------------------------------------
+# Pod manager: scale-down is real (the max() clamp regression)
+# ---------------------------------------------------------------------------
+
+
+class _Handle:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+
+
+class FakeSubstrateManager:
+    """In-process ElasticWorkerManager with a no-op substrate — real
+    supervision/scaling logic, no child processes."""
+
+    def __new__(cls, *args, **kwargs):
+        from elasticdl_tpu.master.pod_manager import ElasticWorkerManager
+
+        class _Fake(ElasticWorkerManager):
+            def _substrate_launch(self, worker_ids):
+                return [_Handle(wid) for wid in worker_ids]
+
+            def _substrate_poll(self, handle):
+                return None  # everyone stays alive
+
+            def _substrate_terminate(self, handles):
+                pass
+
+            def _substrate_kill(self, handle, sig=9):
+                pass
+
+        return _Fake(*args, **kwargs)
+
+
+def test_scale_down_lowers_target_and_sticks(
+    journal_file, obs_registry_snapshot
+):
+    """Regression: scale() used to clamp the target with max(), so a
+    scale-down was immediately undone by _maybe_scale_up regrowth."""
+    goodput.reset_ledger()
+    manager = FakeSubstrateManager(
+        num_workers=3,
+        worker_argv_fn=lambda wid: ["true"],
+        poll_interval_s=0.02,
+        scale_up_check_fn=lambda needed: needed,  # capacity always there
+    )
+    try:
+        manager.start()
+        assert len(manager.current_worker_ids()) == 3
+        manager.scale(2)
+        assert manager.target_num_workers() == 2
+        import time as _time
+
+        _time.sleep(0.2)  # several monitor polls: regrow must NOT happen
+        assert len(manager.current_worker_ids()) == 2
+        assert manager.target_num_workers() == 2
+        # Raising the target through the restore path regrows.
+        manager.set_target_num_workers(3)
+        deadline = _time.time() + 5
+        while len(manager.current_worker_ids()) != 3:
+            assert _time.time() < deadline, "regrow to restored target"
+            _time.sleep(0.02)
+    finally:
+        manager.stop()
+        goodput.reset_ledger()
+    scale_events = [
+        e for e in _events(journal_file) if e["event"] == "scale"
+    ]
+    assert [e["direction"] for e in scale_events] == ["down"]
+    assert scale_events[0]["old_size"] == 3
+    assert scale_events[0]["new_size"] == 2
+    assert any(e["event"] == "scale_up" for e in _events(journal_file))
+
+
+def test_scale_rejects_zero(obs_registry_snapshot):
+    manager = FakeSubstrateManager(
+        num_workers=1, worker_argv_fn=lambda wid: ["true"]
+    )
+    with pytest.raises(ValueError):
+        manager.scale(0)
