@@ -4,7 +4,7 @@
 # targets are the explicit developer entry points.
 
 .PHONY: all proto native test test-fast test-chaos test-obs e2e bench \
-        wheel clean lint check-invariants
+        bench-regress wheel clean lint check-invariants
 
 all: proto native test
 
@@ -62,11 +62,13 @@ test-fast: lint
 # selftest over the golden journal fixture.
 test-obs:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
-	       tests/test_telemetry.py tests/test_goodput.py -q
+	       tests/test_telemetry.py tests/test_goodput.py \
+	       tests/test_stepstats.py -q
 	python scripts/validate_journal.py --selftest --check-sources
 	python scripts/validate_journal.py tests/golden_journal.jsonl
 	JAX_PLATFORMS=cpu python -m elasticdl_tpu.obs.report \
 	       --selftest tests/golden_journal.jsonl
+	JAX_PLATFORMS=cpu python scripts/bench_regress.py --selftest
 
 # Transient-failure resilience gate: deterministic fault injection
 # (common/faults.py, incl. the schedule-based @t storm triggers), the
@@ -85,6 +87,14 @@ e2e:
 
 bench:
 	python bench.py
+
+# The canonical way to publish a perf claim (ROADMAP item 5): run the
+# bench, gate every tracked metric against BASELINE.md's recorded
+# value±spread (bench.SELF_BASELINE), journal a `bench_regress` event,
+# and fail loud on beyond-spread regressions.  `--selftest` (in
+# test-obs) proves the gate itself on CPU with no accelerator.
+bench-regress:
+	python scripts/bench_regress.py
 
 wheel:
 	python -m pip wheel --no-deps --wheel-dir dist .

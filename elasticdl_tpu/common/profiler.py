@@ -58,6 +58,7 @@ class StepProfiler:
             )
         window = parse_profile_steps(profile_steps)
         self._window = window
+        self._worker_id = int(worker_id)
         self._dir = (
             os.path.join(log_dir, "profile", f"worker_{worker_id}")
             if window
@@ -106,6 +107,7 @@ class StepProfiler:
                 logger.info(
                     "Profiling steps [%d, %d) -> %s", start, end, self._dir
                 )
+                self._journal_window("open", at_step=current_step)
             except Exception:
                 logger.exception("start_trace failed; profiling disabled")
                 self._done = True
@@ -132,3 +134,25 @@ class StepProfiler:
             logger.exception("stop_trace failed")
         self._tracing = False
         self._done = True
+        self._journal_window("close")
+
+    def _journal_window(self, action: str, at_step=None):
+        """Journal a ``profile_window`` event so postmortem timelines
+        (obs.report) can point at the TensorBoard trace that covers an
+        anomalous window.  Best-effort: journaling failure must never
+        break tracing (this also runs on the atexit shutdown path)."""
+        try:
+            from elasticdl_tpu import obs
+
+            fields = dict(
+                worker_id=self._worker_id,
+                action=action,
+                step_start=self._window[0],
+                step_end=self._window[1],
+                trace_dir=self._dir,
+            )
+            if at_step is not None:
+                fields["at_step"] = int(at_step)
+            obs.journal().record("profile_window", **fields)
+        except Exception:
+            logger.exception("profile_window journal record failed")

@@ -11,7 +11,11 @@ run and a production incident get identical forensics:
 
 Output: a human-readable timeline (one line per phase segment, rescale
 and churn markers inline), an attribution table (seconds and share of
-wall-clock per phase), a per-rescale cost breakdown
+wall-clock per phase), a compute-phase attribution table (the step
+anatomy's data_wait/stage/compile/execute/bookkeep split from
+`step_anatomy` events, with per-worker dominant phases, straggler
+bottleneck evidence, and `profile_window` pointers at the TensorBoard
+traces covering anomalous windows), a per-rescale cost breakdown
 (detection/rendezvous/redo), and a one-line verdict ("job ran 41m,
 goodput 87.3%; rescale #2 cost 93s: ...").  `--json` writes the same
 facts machine-readably.
@@ -157,6 +161,7 @@ def summarize(events: List[dict]) -> dict:
         if event.get("event") == "rescale_cost"
     ]
     summaries = [e for e in events if e.get("event") == "goodput_summary"]
+    compute = _compute_attribution(events)
     # Independent cross-check channel: the seconds each phase_transition
     # CARRIED (the emitting ledger's own accounting), as opposed to the
     # timestamp-derived segment durations above.  Derived time per phase
@@ -194,6 +199,7 @@ def summarize(events: List[dict]) -> dict:
         "events": len(events),
         "start_ts": events[0]["ts"],
         "end_ts": events[-1]["ts"],
+        **compute,
     }
     if summaries:
         final = summaries[-1]
@@ -205,6 +211,92 @@ def summarize(events: List[dict]) -> dict:
             )
         }
     return summary
+
+
+def _compute_attribution(events: List[dict]) -> dict:
+    """The compute-plane half of the postmortem (docs/observability.md
+    "Step anatomy"): fold ``step_anatomy`` events (cumulative per-worker
+    phase totals — the LATEST per worker wins), ``straggler_detected``
+    anatomy evidence, and ``profile_window`` trace pointers."""
+    latest: Dict[int, dict] = {}
+    straggler_attr: List[dict] = []
+    profile_windows: List[dict] = []
+    for event in events:
+        kind = event.get("event")
+        if kind == "step_anatomy" and event.get("worker_id") is not None:
+            latest[event["worker_id"]] = event
+        elif kind == "straggler_detected" and event.get("dominant_phase"):
+            straggler_attr.append(
+                {
+                    key: event.get(key)
+                    for key in (
+                        "worker_id", "metric", "dominant_phase",
+                        "dominant_phase_fraction", "fleet_phase_fraction",
+                        "phase_ratio",
+                    )
+                    if event.get(key) is not None
+                }
+            )
+        elif kind == "profile_window":
+            profile_windows.append(
+                {
+                    key: event.get(key)
+                    for key in (
+                        "ts", "worker_id", "action", "step_start",
+                        "step_end", "trace_dir",
+                    )
+                    if event.get(key) is not None
+                }
+            )
+    out: dict = {}
+    if profile_windows:
+        out["profile_windows"] = profile_windows
+    if straggler_attr:
+        out["straggler_attribution"] = straggler_attr
+    if not latest:
+        return out
+    fleet_seconds: Dict[str, float] = {}
+    workers: Dict[int, dict] = {}
+    for wid, event in latest.items():
+        totals = event.get("totals")
+        if not isinstance(totals, dict):
+            continue  # forensics over arbitrary journals: skip, don't die
+        seconds = {
+            phase: float(value)
+            for phase, value in totals.items()
+            if isinstance(value, (int, float))
+            and not isinstance(value, bool)
+        }
+        accounted = sum(seconds.values())
+        if accounted <= 0:
+            continue  # all-zero totals: nothing to attribute
+        fractions = {
+            phase: round(value / accounted, 4)
+            for phase, value in seconds.items()
+        }
+        for phase, value in seconds.items():
+            fleet_seconds[phase] = fleet_seconds.get(phase, 0.0) + value
+        workers[wid] = {
+            "seconds": {p: round(s, 6) for p, s in seconds.items()},
+            "fractions": fractions,
+            "dominant_phase": max(fractions, key=fractions.get),
+            "bound": event.get("bound"),
+            "retraces": event.get("retraces"),
+            "mfu": event.get("mfu"),
+        }
+    if not workers:
+        return out
+    accounted = sum(fleet_seconds.values())
+    out["compute"] = {
+        "seconds": {p: round(s, 6) for p, s in sorted(fleet_seconds.items())},
+        "fractions": {
+            p: round(s / accounted, 4)
+            for p, s in sorted(fleet_seconds.items())
+        },
+        "bottleneck": max(fleet_seconds, key=fleet_seconds.get),
+        "workers": workers,
+    }
+    return out
 
 
 def _fmt_duration(seconds: float) -> str:
@@ -241,6 +333,63 @@ def render_report(summary: dict, max_segments: int = 80) -> str:
             f"  {phase:<20} {_fmt_duration(seconds):>8}  "
             f"{100 * seconds / total:5.1f}%  [{marker}]"
         )
+    compute = summary.get("compute")
+    if compute:
+        lines.append("")
+        lines.append(
+            "compute-phase attribution (step anatomy, share of fleet "
+            "step time):"
+        )
+        for phase, seconds in sorted(
+            compute["seconds"].items(), key=lambda kv: -kv[1]
+        ):
+            marker = (
+                " <- bottleneck" if phase == compute["bottleneck"] else ""
+            )
+            lines.append(
+                f"  {phase:<20} {_fmt_duration(seconds):>8}  "
+                f"{100 * compute['fractions'][phase]:5.1f}%{marker}"
+            )
+        for wid in sorted(compute["workers"]):
+            worker = compute["workers"][wid]
+            dominant = worker["dominant_phase"]
+            extra = ""
+            if worker.get("bound"):
+                extra += f", bound: {worker['bound']}"
+            if worker.get("retraces"):
+                extra += f", retraces: {worker['retraces']}"
+            if worker.get("mfu") is not None:
+                extra += f", mfu: {worker['mfu']}"
+            lines.append(
+                f"  worker {wid}: dominant {dominant} "
+                f"({100 * worker['fractions'][dominant]:.0f}%{extra})"
+            )
+    for finding in summary.get("straggler_attribution", ()):
+        ratio = finding.get("phase_ratio")
+        versus = (
+            f" ({ratio}x the fleet median "
+            f"{finding.get('fleet_phase_fraction')})"
+            if ratio is not None
+            else ""
+        )
+        lines.append(
+            f"  straggler worker {finding.get('worker_id')}: "
+            f"{finding.get('metric')} over threshold; dominant phase "
+            f"{finding.get('dominant_phase')} at "
+            f"{finding.get('dominant_phase_fraction')}{versus}"
+        )
+    profile_windows = summary.get("profile_windows")
+    if profile_windows:
+        lines.append("")
+        lines.append("profiler traces (jax.profiler windows):")
+        t0 = summary.get("start_ts", 0.0)
+        for window in profile_windows:
+            lines.append(
+                f"  +{(window.get('ts', t0) or t0) - t0:9.2f}s  "
+                f"worker {window.get('worker_id')} {window.get('action')} "
+                f"steps [{window.get('step_start')}, "
+                f"{window.get('step_end')}) -> {window.get('trace_dir')}"
+            )
     if summary["rescales"]:
         lines.append("")
         lines.append("rescales:")
@@ -357,6 +506,21 @@ def selftest(path: str) -> int:
         )
     if not (0.0 <= summary["goodput_ratio"] <= 1.0):
         problems.append(f"goodput_ratio {summary['goodput_ratio']} not in [0,1]")
+    compute = summary.get("compute")
+    if compute:
+        fraction_sum = sum(compute["fractions"].values())
+        if abs(fraction_sum - 1.0) > 0.02:
+            problems.append(
+                "compute-phase fractions sum to "
+                f"{fraction_sum:.4f}, not ~1.0"
+            )
+        for wid, worker in compute["workers"].items():
+            worker_sum = sum(worker["fractions"].values())
+            if abs(worker_sum - 1.0) > 0.02:
+                problems.append(
+                    f"worker {wid} phase fractions sum to "
+                    f"{worker_sum:.4f}, not ~1.0"
+                )
     for r in summary["rescales"]:
         parts = sum(
             r.get(k) or 0.0 for k in ("detection_s", "rendezvous_s", "redo_s")

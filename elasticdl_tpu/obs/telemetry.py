@@ -38,6 +38,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.obs import stepstats
 
 logger = get_logger("obs.telemetry")
 
@@ -109,6 +110,14 @@ def sanitize_snapshot(snapshot) -> Optional[dict]:
                 return None
             clean_task["type"] = type_name[:32]
         clean["task"] = clean_task
+    anatomy = snapshot.get("anatomy")
+    if anatomy is not None:
+        # Anatomy is supplementary: a malformed sub-dict degrades to
+        # absent (sanitize_anatomy whitelists) instead of rejecting the
+        # snapshot — the liveness/step signal must survive it.
+        clean_anatomy = stepstats.sanitize_anatomy(anatomy)
+        if clean_anatomy is not None:
+            clean["anatomy"] = clean_anatomy
     rpc = snapshot.get("rpc")
     if rpc is not None:
         if not isinstance(rpc, dict):
@@ -145,6 +154,7 @@ class WorkerTelemetry:
         self._task_records_total = 0  # guarded-by: _lock
         self._task_records_done = 0  # guarded-by: _lock
         self._retry_stats = None  # guarded-by: _lock
+        self._anatomy = None  # guarded-by: _lock
 
     @property
     def worker_id(self) -> int:
@@ -155,6 +165,17 @@ class WorkerTelemetry:
         retry plane's per-worker view."""
         with self._lock:
             self._retry_stats = stats
+
+    def bind_anatomy(self, anatomy) -> None:
+        """Attach a StepAnatomy (obs/stepstats.py) so snapshots carry the
+        step-time decomposition under the ``anatomy`` key."""
+        with self._lock:
+            self._anatomy = anatomy
+
+    @property
+    def anatomy(self):
+        with self._lock:
+            return self._anatomy
 
     def set_rendezvous(self, rendezvous_id: int) -> None:
         with self._lock:
@@ -189,6 +210,7 @@ class WorkerTelemetry:
         with self._lock:
             steps = sorted(self._step_times)
             retry_stats = self._retry_stats
+            anatomy = self._anatomy
             snap = {
                 "v": SNAPSHOT_VERSION,
                 "worker_id": self._worker_id,
@@ -212,16 +234,47 @@ class WorkerTelemetry:
                 "retries": retry_stats.retries,
                 "give_ups": retry_stats.give_ups,
             }
+        if anatomy is not None:
+            try:
+                snap["anatomy"] = anatomy.snapshot()
+            except Exception:
+                # Anatomy is supplementary: it must never take the
+                # liveness snapshot down with it.
+                logger.exception("StepAnatomy snapshot failed; omitted")
         return snap
 
+    @staticmethod
+    def _dumps(snap: dict) -> str:
+        return json.dumps(snap, separators=(",", ":"))
+
     def snapshot_json(self) -> str:
-        payload = json.dumps(self.snapshot(), separators=(",", ":"))
+        snap = self.snapshot()
+        payload = self._dumps(snap)
+        # Size-budget ladder: a snapshot nearing the 4 KiB heartbeat
+        # bound sheds the ANATOMY detail first — windows oldest-first,
+        # then per-function compile counts, then the whole sub-dict —
+        # so the core liveness/step fields always deliver.  The final
+        # identity fallback stays only for pathological core bloat.
+        anatomy = snap.get("anatomy")
+        while (
+            len(payload.encode("utf-8")) > MAX_SNAPSHOT_BYTES
+            and isinstance(anatomy, dict)
+        ):
+            windows = anatomy.get("windows")
+            if windows:
+                windows.pop(0)  # oldest window first
+            elif "compiles" in anatomy or "windows" in anatomy:
+                anatomy.pop("compiles", None)
+                anatomy.pop("windows", None)
+            else:
+                snap.pop("anatomy", None)
+                anatomy = None
+            payload = self._dumps(snap)
         if len(payload.encode("utf-8")) > MAX_SNAPSHOT_BYTES:
             # Degrade to the minimal identity snapshot rather than ship a
             # bloated heartbeat (only reachable via oversized task names).
-            payload = json.dumps(
-                {"v": SNAPSHOT_VERSION, "worker_id": self._worker_id},
-                separators=(",", ":"),
+            payload = self._dumps(
+                {"v": SNAPSHOT_VERSION, "worker_id": self._worker_id}
             )
         return payload
 
@@ -396,6 +449,12 @@ class TelemetryAggregator:
         # wid -> {"snapshot", "received", "journaled"} (monotonic clocks).
         self._reports: Dict[int, dict] = {}  # guarded-by: _lock
         self._callbacks: List[Callable[[int, bool, dict], None]] = []  # guarded-by: _lock
+        # Scrape-path memo for the anatomy fold: 5 phase gauges + the
+        # retrace gauge would otherwise each re-fold every snapshot per
+        # scrape.  Keyed on the ingest sequence — any new snapshot
+        # invalidates.
+        self._ingest_seq = 0  # guarded-by: _lock
+        self._attribution_cache = (-1, None)  # guarded-by: _lock
 
         self._m_reports = obs.counter(
             "elasticdl_telemetry_reports_total",
@@ -435,6 +494,27 @@ class TelemetryAggregator:
             "elasticdl_telemetry_staleness_seconds",
             "Oldest current-worker telemetry report (seconds ago)",
         ).set_function(self._max_staleness)
+        # Step-anatomy fleet view (obs/stepstats.py): fraction of fleet
+        # compute-plane time per sub-phase.  `phase` is a bounded enum
+        # (stepstats.PHASES) — per-worker/per-function detail stays
+        # journal-only per the cardinality rule.
+        phase_fraction = obs.gauge(
+            "elasticdl_worker_phase_fraction",
+            "Fleet step-time fraction per anatomy sub-phase",
+            labelnames=("phase",),
+        )
+        for phase_name in stepstats.PHASES:
+            phase_fraction.set_function(
+                (lambda p: lambda: self._fleet_phase_fraction(p))(
+                    phase_name
+                ),
+                phase=phase_name,
+            )
+        obs.gauge(
+            "elasticdl_worker_retraces",
+            "Fleet total of reported jit retraces (compiles beyond the "
+            "first per entrypoint)",
+        ).set_function(self._fleet_retraces)
 
     # -- read side (gauge callbacks; take only the aggregator lock) -----
 
@@ -468,6 +548,30 @@ class TelemetryAggregator:
             return 0.0
         now = self._clock()
         return round(max(now - r["received"] for r in reports.values()), 3)
+
+    def fleet_attribution(self) -> dict:
+        """The compute-plane bottleneck view (stepstats.fleet_attribution
+        over current-world snapshots): summed phase seconds, fractions,
+        the bottleneck phase, per-worker dominant phases, fleet retrace
+        total.  Memoized per ingest so one scrape's six gauge callbacks
+        fold the snapshots once, not six times."""
+        with self._lock:
+            seq = self._ingest_seq
+            cached_seq, cached = self._attribution_cache
+        if cached_seq == seq and cached is not None:
+            return cached
+        attribution = stepstats.fleet_attribution(self.worker_snapshots())
+        with self._lock:
+            self._attribution_cache = (seq, attribution)
+        return attribution
+
+    def _fleet_phase_fraction(self, phase: str) -> float:
+        return float(
+            self.fleet_attribution()["fractions"].get(phase, 0.0)
+        )
+
+    def _fleet_retraces(self) -> float:
+        return float(self.fleet_attribution().get("retraces", 0))
 
     def stragglers(self) -> Dict[int, dict]:
         with self._lock:
@@ -535,6 +639,7 @@ class TelemetryAggregator:
                 self._reports[worker_id] = entry
             entry["snapshot"] = snapshot
             entry["received"] = now
+            self._ingest_seq += 1
             if now - entry["journaled"] >= self._journal_interval_s:
                 entry["journaled"] = now
                 journal_it = True
@@ -547,14 +652,57 @@ class TelemetryAggregator:
             fields = {
                 key: value
                 for key, value in snapshot.items()
-                if key not in ("v", "worker_id", "ts")
+                if key not in ("v", "worker_id", "ts", "anatomy")
             }
             if "ts" in snapshot:
                 fields["worker_ts"] = snapshot["ts"]
             obs.journal().record(
                 "worker_telemetry", worker_id=worker_id, **fields
             )
+            anatomy = snapshot.get("anatomy")
+            if isinstance(anatomy, dict):
+                # The compute-plane decomposition journals as its OWN
+                # schema-registered event (same per-worker rate limit),
+                # keeping worker_telemetry lean; windows stay
+                # heartbeat-only — cumulative totals reconstruct the
+                # attribution (obs.report "compute-phase attribution").
+                self._journal_anatomy(worker_id, anatomy)
         self._detect(now, updated={worker_id})
+
+    @staticmethod
+    def _journal_anatomy(worker_id: int, anatomy: dict) -> None:
+        stepstats.journal_anatomy(worker_id, anatomy)
+
+    def _anatomy_evidence(self, worker_id: int) -> dict:
+        """Compute-plane evidence for a straggler transition: the
+        flagged worker's dominant phase and how its fraction compares
+        to the fleet median of the same phase — what upgrades the
+        journal verdict from "slow" to "slow because data_wait is Nx
+        the fleet median"."""
+        snapshots = self.worker_snapshots()
+        mine = (snapshots.get(worker_id) or {}).get("anatomy") or {}
+        fractions = stepstats.phase_fractions(mine.get("totals") or {})
+        if not fractions:
+            return {}
+        dominant = max(fractions, key=fractions.get)
+        peer_fractions = sorted(
+            stepstats.phase_fractions(
+                (snap.get("anatomy") or {}).get("totals") or {}
+            ).get(dominant, 0.0)
+            for wid, snap in snapshots.items()
+            if wid != worker_id and snap.get("anatomy")
+        )
+        evidence = {
+            "dominant_phase": dominant,
+            "dominant_phase_fraction": fractions[dominant],
+        }
+        if peer_fractions:
+            fleet_median = _quantile(peer_fractions, 0.5)
+            evidence["fleet_phase_fraction"] = round(fleet_median, 4)
+            evidence["phase_ratio"] = round(
+                fractions[dominant] / max(fleet_median, 1e-6), 1
+            )
+        return evidence
 
     def _detect(self, now: float, updated: Optional[set] = None) -> None:
         reports = self._fleet_reports()
@@ -576,6 +724,10 @@ class TelemetryAggregator:
         for transition in transitions:
             wid = transition["worker_id"]
             if transition["flagged"]:
+                # Attach the step-anatomy evidence BEFORE journaling so
+                # the straggler record itself names the bottleneck
+                # phase (not just "slow").
+                transition.update(self._anatomy_evidence(wid))
                 logger.warning(
                     "Straggler detected: worker %d (%s=%s > threshold %s, "
                     "fleet median %s)",

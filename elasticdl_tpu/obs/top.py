@@ -36,8 +36,14 @@ _HEADER_GAUGES = (
 
 _COLUMNS = (
     "WORKER", "AGE(s)", "P50(ms)", "P95(ms)", "EX/S",
-    "TASK", "PROGRESS", "RDZV", "RETRY", "STATE",
+    "TASK", "PROGRESS", "RDZV", "RETRY",
+    "DW%", "ST%", "CO%", "EX%", "BK%", "BOUND", "STATE",
 )
+
+#: Step-anatomy phase -> its percent column, in render order
+#: (obs/stepstats.PHASES; data_wait / stage / compile / execute /
+#: bookkeep — the per-worker phase-fraction columns).
+_PHASE_COLUMNS = ("data_wait", "stage", "compile", "execute", "bookkeep")
 
 
 def fetch_text(url: str, timeout_s: float = 5.0) -> str:
@@ -129,6 +135,7 @@ def worker_rows(
     recent ``straggler_detected``/``straggler_cleared`` transition."""
     now = time.time() if now is None else now
     latest: Dict[int, dict] = {}
+    anatomy: Dict[int, dict] = {}
     straggling: Dict[int, dict] = {}
     for event in events:
         kind = event.get("event")
@@ -137,20 +144,27 @@ def worker_rows(
             continue
         if kind == "worker_telemetry":
             latest[wid] = event
+        elif kind == "step_anatomy":
+            anatomy[wid] = event
         elif kind == "straggler_detected":
             straggling[wid] = event
         elif kind == "straggler_cleared":
             straggling.pop(wid, None)
     rows = []
-    for wid in sorted(latest):
-        event = latest[wid]
+    for wid in sorted(set(latest) | set(anatomy)):
+        event = latest.get(wid, {})
         task = event.get("task") or {}
         total = task.get("records_total") or 0
         done = task.get("records_done") or 0
         progress = f"{done}/{total}" if total else "-"
         state = "ok"
         if wid in straggling:
-            state = f"STRAGGLER({straggling[wid].get('metric', '?')})"
+            marker = straggling[wid].get("metric", "?")
+            dominant = straggling[wid].get("dominant_phase")
+            if dominant:
+                marker = f"{marker}:{dominant}"
+            state = f"STRAGGLER({marker})"
+        fractions = (anatomy.get(wid) or {}).get("fractions") or {}
         rows.append(
             {
                 "worker": wid,
@@ -162,6 +176,11 @@ def worker_rows(
                 "progress": progress,
                 "rendezvous_id": event.get("rendezvous_id", 0),
                 "retries": (event.get("rpc") or {}).get("retries", 0),
+                "phases": {
+                    phase: _pct(fractions.get(phase))
+                    for phase in _PHASE_COLUMNS
+                },
+                "bound": (anatomy.get(wid) or {}).get("bound") or "-",
                 "state": state,
             }
         )
@@ -172,6 +191,12 @@ def _ms(seconds) -> str:
     if seconds is None:
         return "-"
     return f"{float(seconds) * 1e3:.1f}"
+
+
+def _pct(fraction) -> str:
+    if fraction is None:
+        return "-"
+    return f"{float(fraction) * 100:.0f}"
 
 
 def render(
@@ -197,6 +222,7 @@ def render(
         lines.append(job_header)
     table: List[Tuple[str, ...]] = [_COLUMNS]
     for row in rows:
+        phases = row.get("phases") or {}
         table.append(
             (
                 str(row["worker"]),
@@ -208,6 +234,8 @@ def render(
                 str(row["progress"]),
                 str(row["rendezvous_id"]),
                 str(row["retries"]),
+                *(phases.get(phase, "-") for phase in _PHASE_COLUMNS),
+                str(row.get("bound", "-")),
                 row["state"],
             )
         )

@@ -159,6 +159,17 @@ class DataParallelTrainer:
             out_shardings=batch,
         )
 
+    def jitted_entrypoints(self) -> dict:
+        """Current jitted entrypoints by name — the step-anatomy
+        retrace watcher (obs/stepstats.py) polls their compile-cache
+        sizes between dispatches.  Empty until first compile; re-read
+        per poll because compilation is lazy."""
+        return {
+            "dp_train_step": self._train_step,
+            "dp_train_window": self._train_window_jit,
+            "dp_eval_step": self._eval_step,
+        }
+
     # -- state ----------------------------------------------------------
 
     @property

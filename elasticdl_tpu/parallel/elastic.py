@@ -279,18 +279,29 @@ def _decode_task(arr: np.ndarray, shard_names: List[str]) -> pb.Task:
 
 
 def broadcast_task(
-    task: Optional[pb.Task], shard_names: List[str], world: WorldInfo
+    task: Optional[pb.Task], shard_names: List[str], world: WorldInfo,
+    anatomy=None,
 ) -> pb.Task:
     """All ranks call this; rank 0 supplies the task, everyone returns it.
 
     `shard_names` must be identical (same order) on every rank — it comes
     from the deterministic data reader shard listing each rank builds.
+
+    `anatomy` (obs/stepstats.StepAnatomy, optional) books the broadcast
+    wall under `data_wait` on NON-leader ranks: for them this collective
+    IS the task-queue wait (they block here while rank 0 talks to the
+    master), and the step-anatomy ledger would otherwise blame the gap
+    on whatever phase ran last.  Booked after the fact and only for real
+    tasks — a WAIT poll is queue idleness (the goodput ledger's `idle`),
+    not data starvation, and must not corrupt the anatomy.  The leader's
+    wait (get_task + this broadcast) is booked by its own task loop.
     """
     if world.world_size == 1:
         assert task is not None
         return task
     from jax.experimental import multihost_utils
 
+    start = time.monotonic()
     encoded = multihost_utils.broadcast_one_to_all(
         _encode_task(task, shard_names), is_source=world.is_leader
     )
@@ -300,7 +311,15 @@ def broadcast_task(
         # only rank that reports results — its trace id must survive the
         # broadcast round-trip.
         return task
-    return _decode_task(np.asarray(encoded), shard_names)
+    decoded = _decode_task(np.asarray(encoded), shard_names)
+    if (
+        anatomy is not None
+        and not world.is_leader
+        and decoded.task_id != -1
+        and decoded.type != pb.WAIT
+    ):
+        anatomy.note_phase_seconds("data_wait", time.monotonic() - start)
+    return decoded
 
 
 # ---------------------------------------------------------------------------

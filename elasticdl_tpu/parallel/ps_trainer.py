@@ -134,6 +134,15 @@ class ShardedEmbeddingTrainer:
     def mesh(self):
         return self._mesh
 
+    def jitted_entrypoints(self) -> dict:
+        """Current jitted entrypoints by name for the step-anatomy
+        retrace watcher (obs/stepstats.py; see DataParallelTrainer)."""
+        return {
+            "ps_train_step": self._train_step,
+            "ps_train_window": getattr(self, "_train_window", None),
+            "ps_eval_step": self._eval_step,
+        }
+
     def local_block(self, per_rank_batch: int) -> int:
         local_devices = max(1, self._dp // jax.process_count())
         return -(-per_rank_batch // local_devices) * local_devices

@@ -16,6 +16,7 @@ failure.  TPU design differences (see parallel/elastic.py):
 
 from __future__ import annotations
 
+import contextlib
 import time
 import traceback
 from typing import List, Optional
@@ -59,6 +60,7 @@ class CollectiveWorker:
         profiler=None,
         train_window_steps: int = 0,
         telemetry=None,
+        anatomy=None,
     ):
         self._mc = master_client
         self._spec = model_spec
@@ -69,6 +71,16 @@ class CollectiveWorker:
         # step times / task progress recorded here ride the heartbeat to
         # the master's aggregator.  None = telemetry plane off (tests).
         self._telemetry = telemetry
+        # Step-anatomy ledger (obs/stepstats.StepAnatomy): decomposes
+        # each dispatch's wall time into data_wait / stage / compile /
+        # execute / bookkeep with host-side clocks.  Defaults to the one
+        # bound to the telemetry collector (worker/main wiring), so its
+        # windows ride the same heartbeat.  None = anatomy off.
+        self._anatomy = anatomy or getattr(telemetry, "anatomy", None)
+        if self._anatomy is not None and hasattr(
+            trainer, "jitted_entrypoints"
+        ):
+            self._anatomy.watch_jits(trainer.jitted_entrypoints)
         # Each process supplies `block` rows per collective step (>= mb,
         # rounded up to divide its local device count).
         self._block = trainer.local_block(minibatch_size)
@@ -220,12 +232,43 @@ class CollectiveWorker:
                 "from a consistent snapshot"
             )
 
+    # -- step anatomy (no-op contexts when the plane is off) ------------
+
+    def _anat_phase(self, name: str):
+        if self._anatomy is None:
+            return contextlib.nullcontext()
+        return self._anatomy.phase(name)
+
+    def _anat_dispatch(self, n_steps: int, n_examples: int):
+        if self._anatomy is None:
+            return contextlib.nullcontext()
+        return self._anatomy.dispatch(n_steps, n_examples)
+
     def _run_task_loop(self):
         self.restore_from_checkpoint()
         self._verify_restore_consistency()
         while True:
+            # Queue wait is data_wait — but only for REAL tasks: a WAIT
+            # poll is queue idleness (the ledger's `idle` phase below),
+            # and booking it would misattribute scheduler gaps as data
+            # starvation.  So measure, then book after the type is
+            # known.  The leader's interval covers get_task + broadcast;
+            # non-leader ranks book their broadcast wait inside
+            # broadcast_task under the same rule.
+            queue_wait_start = time.monotonic()
             task = self._mc.get_task() if self._world.is_leader else None
-            task = elastic.broadcast_task(task, self._shard_names, self._world)
+            task = elastic.broadcast_task(
+                task, self._shard_names, self._world, anatomy=self._anatomy
+            )
+            if (
+                self._anatomy is not None
+                and self._world.is_leader
+                and task.task_id != -1
+                and task.type != pb.WAIT
+            ):
+                self._anatomy.note_phase_seconds(
+                    "data_wait", time.monotonic() - queue_wait_start
+                )
             if task.task_id == -1 and task.type != pb.WAIT:
                 logger.info(
                     "Job complete; rank %d exiting", self._world.rank
@@ -500,35 +543,58 @@ class CollectiveWorker:
             if len(pending) == window_steps and hasattr(
                 self._trainer, "stage_window"
             ):
-                window = self._trainer.stage_window(pending)
-                losses = self._trainer.train_window(window)
+                with self._anat_phase("stage"):
+                    window = self._trainer.stage_window(pending)
+                with self._anat_dispatch(len(pending), pending_real):
+                    losses = self._trainer.train_window(window)
                 last_loss = losses[-1]
             else:
-                for staged_batch in pending:
-                    last_loss = self._trainer.train_step_staged(
-                        self._trainer.stage_batch(*staged_batch)
+                for i, staged_batch in enumerate(pending):
+                    with self._anat_phase("stage"):
+                        staged = self._trainer.stage_batch(*staged_batch)
+                    # Real-record count is per-flush, not per-step:
+                    # credit it once so the window's examples are exact.
+                    with self._anat_dispatch(1, pending_real if i == 0 else 0):
+                        last_loss = self._trainer.train_step_staged(staged)
+            with self._anat_phase("bookkeep"):
+                if self._telemetry is not None:
+                    # One telemetry sample per dispatch (not per step):
+                    # the flush's mean step time + real records, feeding
+                    # the heartbeat snapshot's percentiles + examples/s.
+                    self._telemetry.record_steps(
+                        len(pending),
+                        time.monotonic() - flush_start,
+                        records=pending_real,
                     )
-            if self._telemetry is not None:
-                # One telemetry sample per dispatch (not per step): the
-                # flush's mean step time + real records, feeding the
-                # heartbeat snapshot's percentiles and examples/s.
-                self._telemetry.record_steps(
-                    len(pending),
-                    time.monotonic() - flush_start,
-                    records=pending_real,
-                )
-            batch_count += len(pending)
-            record_count += pending_real
-            pending, pending_real = [], 0
-            if self._profiler is not None:
-                self._profiler.after_steps(self._trainer.step)
-            self._report_version_if_due()
-            self._maybe_checkpoint()
+                batch_count += len(pending)
+                record_count += pending_real
+                pending, pending_real = [], 0
+                if self._profiler is not None:
+                    self._profiler.after_steps(self._trainer.step)
+                self._report_version_if_due()
+                self._maybe_checkpoint()
+            if self._anatomy is not None:
+                # One anatomy window per dispatch flush: the unit the
+                # heartbeat snapshot summarizes.
+                self._anatomy.close_window()
 
-        for features, labels, mask, global_real in self._local_batches(
-            task, Mode.TRAINING
-        ):
-            self._trainer.ensure_initialized(features)
+        batches = self._local_batches(task, Mode.TRAINING)
+        while True:
+            # Host data wait: read + parse + batch assembly (and padding)
+            # happen inside the generator — the starvation signal the
+            # step anatomy exists to expose.
+            with self._anat_phase("data_wait"):
+                item = next(batches, None)
+            if item is None:
+                break
+            features, labels, mask, global_real = item
+            if self._trainer.state is None:
+                # First touch: model init + eval_shape + jit build is
+                # compile-plane time, not execute.
+                with self._anat_phase("compile"):
+                    self._trainer.ensure_initialized(features)
+            else:
+                self._trainer.ensure_initialized(features)
             if self._batch_nbytes is None:
                 # One-time refinement of the window from the real
                 # staged-batch size AND the trainer's now-resolved apply

@@ -53,6 +53,19 @@ def main(argv=None):
             faults.ENV_VAR, os.environ.get(faults.ENV_VAR),
         )
     args = parse_worker_args(argv)
+    if getattr(args, "tensorboard_log_dir", ""):
+        # Each process owns its journal (obs scoping rule): give worker
+        # processes a durable file so worker-side events — profile_window
+        # trace pointers, step_anatomy in Local mode, worker spans —
+        # survive the process instead of dying with the in-memory tail.
+        # Distinct filename per worker: no collision with the master's
+        # events.jsonl in the shared log dir.
+        from elasticdl_tpu import obs
+
+        obs.init_journal(
+            args.tensorboard_log_dir,
+            filename=f"events_worker_{args.worker_id}.jsonl",
+        )
     if getattr(args, "jax_compilation_cache_dir", ""):
         import jax
 
@@ -90,7 +103,12 @@ def main(argv=None):
         )
     else:
         from elasticdl_tpu.common.profiler import StepProfiler
+        from elasticdl_tpu.obs.stepstats import StepAnatomy
 
+        anatomy = StepAnatomy(args.worker_id)
+        anatomy.set_model(
+            getattr(args, "model_def", "") or getattr(args, "model_zoo", "")
+        )
         worker = Worker(
             master_client=client,
             model_spec=model_spec,
@@ -101,6 +119,7 @@ def main(argv=None):
             profiler=StepProfiler(
                 args.tensorboard_log_dir, args.profile_steps, args.worker_id
             ),
+            anatomy=anatomy,
         )
     worker.run()
     if args.output and "training" in args.job_type:
@@ -134,6 +153,17 @@ def _build_collective_worker(
     telemetry = WorkerTelemetry(args.worker_id)
     telemetry.bind_retry_stats(client.retry_stats)
     telemetry.set_rendezvous(world.rendezvous_id)
+    # Step-anatomy ledger (docs/observability.md "Step anatomy"): the
+    # phase decomposition rides the same heartbeat snapshot; the
+    # CollectiveWorker reads it off the telemetry binding and registers
+    # the trainer's jitted entrypoints for retrace detection.
+    from elasticdl_tpu.obs.stepstats import StepAnatomy
+
+    anatomy = StepAnatomy(args.worker_id)
+    anatomy.set_model(
+        getattr(args, "model_def", "") or getattr(args, "model_zoo", "")
+    )
+    telemetry.bind_anatomy(anatomy)
     # All devices of the joined world, shaped (data, model): the model
     # axis carries sharded embedding tables and — for mesh-aware zoo
     # models — ring-attention context parallelism.

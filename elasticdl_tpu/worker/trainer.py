@@ -81,6 +81,14 @@ class Trainer:
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
         self._eval_step = jax.jit(self._eval_step_impl)
 
+    def jitted_entrypoints(self) -> dict:
+        """Jitted entrypoints by name for the step-anatomy retrace
+        watcher (obs/stepstats.py)."""
+        return {
+            "train_step": self._train_step,
+            "eval_step": self._eval_step,
+        }
+
     # ------------------------------------------------------------------
 
     def _init_state(self, features) -> TrainState:

@@ -8,6 +8,7 @@ ship outputs/labels to the master for aggregation.
 
 from __future__ import annotations
 
+import contextlib
 import time
 import traceback
 from typing import Optional
@@ -41,6 +42,7 @@ class Worker:
         validation_data_reader=None,
         prediction_data_reader=None,
         profiler=None,
+        anatomy=None,
     ):
         self._mc = master_client
         self._spec = model_spec
@@ -70,6 +72,19 @@ class Worker:
         self._max_consecutive_failures = max_consecutive_task_failures
         self._last_reported_version = 0
         self._profiler = profiler
+        # Step-anatomy ledger (obs/stepstats.StepAnatomy, optional):
+        # host-clock decomposition of the train loop into data_wait /
+        # compile / execute / bookkeep sub-phases.
+        self._anatomy = anatomy
+        if anatomy is not None and hasattr(
+            self._trainer, "jitted_entrypoints"
+        ):
+            anatomy.watch_jits(self._trainer.jitted_entrypoints)
+
+    def _anat_phase(self, name: str):
+        if self._anatomy is None:
+            return contextlib.nullcontext()
+        return self._anatomy.phase(name)
 
     @property
     def trainer(self) -> Trainer:
@@ -172,19 +187,48 @@ class Worker:
         batch_count = 0
         record_count = 0
         last_loss = None
-        for features, labels in dataset:
+        batches = iter(dataset)
+        while True:
+            # Host data wait: record parse + batching live in the
+            # iterator (step anatomy's starvation signal).
+            with self._anat_phase("data_wait"):
+                batch = next(batches, None)
+            if batch is None:
+                break
+            features, labels = batch
             spec = faults.fire("worker.step")
             if spec is not None and spec.kind == "crash":
                 faults.crash_now(spec)
             if self._profiler is not None:
                 self._profiler.before_steps(self._trainer.step)
-            last_loss = self._trainer.train_step(features, labels)
+            n = _batch_size_of(features)
+            if self._anatomy is not None:
+                # One dispatch per batch in Local mode (staging is fused
+                # into train_step; compile-vs-execute split comes from
+                # the trainer's watched jit cache).
+                with self._anatomy.dispatch(1, n):
+                    last_loss = self._trainer.train_step(features, labels)
+            else:
+                last_loss = self._trainer.train_step(features, labels)
             batch_count += 1
-            record_count += _batch_size_of(features)
-            if self._profiler is not None:
-                self._profiler.after_steps(self._trainer.step)
-            if self._trainer.step % self._report_every == 0:
-                self._report_version()
+            record_count += n
+            with self._anat_phase("bookkeep"):
+                if self._profiler is not None:
+                    self._profiler.after_steps(self._trainer.step)
+                if self._trainer.step % self._report_every == 0:
+                    self._report_version()
+        if self._anatomy is not None:
+            # One anatomy window per task in Local mode — and since this
+            # path has no telemetry heartbeat to carry it, journal the
+            # cumulative anatomy here (the process journal: shared with
+            # the master in-process in Local mode, the worker's own
+            # events_worker_N.jsonl in subprocess runs).
+            from elasticdl_tpu.obs import stepstats
+
+            self._anatomy.close_window()
+            stepstats.journal_anatomy(
+                self._anatomy.worker_id, self._anatomy.snapshot()
+            )
         if last_loss is not None:
             logger.info(
                 "task %d done: step=%d loss=%.5f (%d batches)",

@@ -55,6 +55,15 @@ EVENT_REQUIRED_FIELDS = {
     # Elastic policy engine (master/policy.py — docs/observability.md
     # "Policy decisions"): scale_up/scale_down/evict/hold + evidence.
     "policy_decision": ("action", "reason"),
+    # Step anatomy (obs/stepstats.py — docs/observability.md "Step
+    # anatomy"): per-worker compute-plane phase decomposition.
+    "step_anatomy": ("worker_id",),
+    # StepProfiler trace windows (common/profiler.py): lets obs.report
+    # point at the TensorBoard trace covering an anomalous window.
+    "profile_window": ("worker_id", "action", "trace_dir"),
+    # Bench regression gate (scripts/bench_regress.py): per-metric
+    # verdicts of a bench.py run vs the recorded baseline spread.
+    "bench_regress": ("verdict", "metrics_total", "regressed"),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -204,11 +213,25 @@ def _selftest() -> int:
         {"ts": 6.8, "event": "policy_decision", "action": "evict",
          "reason": "persistent_straggler", "worker_id": 1,
          "flag_streak_ticks": 3, "kill_budget_remaining": 0},
+        {"ts": 6.85, "event": "step_anatomy", "worker_id": 0,
+         "totals": {"data_wait": 1.2, "execute": 4.0}, "steps": 64,
+         "examples": 4096, "retraces": 1, "bound": "host",
+         "fractions": {"data_wait": 0.23, "execute": 0.77},
+         "dominant_phase": "execute"},
+        {"ts": 6.9, "event": "profile_window", "worker_id": 2,
+         "action": "open", "step_start": 100, "step_end": 120,
+         "trace_dir": "/logs/job1/profile/worker_2"},
+        {"ts": 6.95, "event": "bench_regress", "verdict": "regressed",
+         "metrics_total": 8, "regressed": 1,
+         "details": [{"metric": "deepfm", "ratio": 0.8}]},
         {"ts": 7.0, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
         '{"ts": 1.0, "event": "task_requeue"}',        # missing reason
         '{"ts": 1.2, "event": "policy_decision", "action": "hold"}',  # no reason
+        '{"ts": 1.3, "event": "step_anatomy", "totals": {}}',  # no worker_id
+        '{"ts": 1.35, "event": "profile_window", "worker_id": 1}',  # no action
+        '{"ts": 1.4, "event": "bench_regress", "verdict": "ok"}',  # no counts
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts
