@@ -3,8 +3,9 @@
 # first use (pb2 is checked in; the native .so builds lazily); these
 # targets are the explicit developer entry points.
 
-.PHONY: all proto native test test-fast test-chaos test-obs e2e bench \
-        bench-regress wheel clean lint check-invariants
+.PHONY: all proto native test test-fast test-sparse sparse-gates \
+        test-chaos test-obs e2e bench bench-regress wheel clean lint \
+        check-invariants
 
 all: proto native test
 
@@ -50,8 +51,30 @@ lint:
 # The elastic policy-engine units (tests/test_policy.py: eviction
 # hysteresis + kill budget, amortization math, thrash scale-down, the
 # pod-manager scale-down regression) ride in tests/ here.
-test-fast: lint
+# sparse-gates (not the pytest files) chain into test-fast: the kernel
+# test files already ride test-fast's own `pytest tests/` sweep, so
+# chaining full test-sparse would run them twice per tier-1 pass.
+test-fast: lint sparse-gates
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# Script gates of the sparse path, shared by test-sparse and test-fast:
+# the xla-vs-fused microbench's interpret-mode selftest and a tiny
+# fused-vs-xla convergence A/B smoke (the full-scale fused A/B is chip
+# work: `python scripts/convergence_ab.py --all --sparse-kernel fused`).
+sparse-gates:
+	JAX_PLATFORMS=cpu python scripts/exp_sparse_gather.py --selftest
+	JAX_PLATFORMS=cpu python scripts/convergence_ab.py --smoke
+
+# Standalone sparse-path gate (docs/design.md "Fused sparse kernels"):
+# the fused Pallas kernel family vs the XLA reference paths in
+# interpret mode on CPU (bit-exactness / documented-tolerance contracts
+# + the HLO no-row-batch-intermediates assertion), the packed-layout
+# and stream/scatter/fused optimizer semantics they ride on, plus the
+# script gates above.
+test-sparse: sparse-gates
+	JAX_PLATFORMS=cpu python -m pytest tests/test_sparse_kernels.py \
+	       tests/test_sparse_optim_modes.py tests/test_packed.py \
+	       -q -m 'not slow'
 
 # Observability plane gate (docs/observability.md): registry semantics +
 # lockcheck concurrency, exporter endpoint round-trip, journal rotation,

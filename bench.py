@@ -24,6 +24,10 @@ real TPU chip), ten metrics:
 - `deepfm_26m_strict_samples_per_sec_per_chip`: strict per-step apply
   at the same 26M scale (the golden contract under the auto split
   layout — tracked from round 5).
+- `deepfm_train_fused_samples_per_sec_per_chip` (round 6): the
+  headline config on the fused Pallas sparse kernels
+  (`--sparse_kernel=fused`, ops/sparse_embedding.py) — tracked:false
+  until the first driver measurement (BASELINE.md queued chip work).
 - `deepfm_train_samples_per_sec_per_chip` (config 4, printed LAST — the
   flagship headline, strict per-step golden contract): full
   ParameterServerStrategy step — packed sharded embedding lookup, FM +
@@ -94,6 +98,12 @@ def _trimmed_median_spread(times, work_per_run):
 # streaming adam).
 SELF_BASELINE = {
     "deepfm_train_samples_per_sec_per_chip": 87_639.0,
+    # Fused Pallas sparse kernels at the headline config (round 6, code
+    # complete; chip number queued — BASELINE.md).  PROVISIONAL anchor =
+    # the round-4 xla-strict measurement of the SAME config, so
+    # vs_baseline reads directly as the fused-vs-incumbent speedup; the
+    # row stays tracked:false until a driver bench verifies it.
+    "deepfm_train_fused_samples_per_sec_per_chip": 972_913.0,
     # The production data plane, file -> device-ready batches, one host
     # core (first measured round 3; the coupled e2e number is tracked
     # with a wide documented spread — tunnel-transfer-bound, BASELINE.md
@@ -155,6 +165,7 @@ def bench_deepfm(
     repeats: int = 5,             # -> 668k, 400 -> 827k, 800 -> 839k
     embedding_optimizer=None,
     sparse_apply_every: int = 1,
+    sparse_kernel=None,
 ):
     import jax
 
@@ -165,16 +176,19 @@ def bench_deepfm(
     mesh = build_mesh(MeshConfig())
     trainer = ShardedEmbeddingTrainer(
         # The model's per-mode table layout must see the SAME apply mode
-        # the trainer runs (merged table under windowed apply, split
-        # under strict at >10M rows — model_zoo/deepfm SPLIT_TABLE_ROWS).
+        # AND kernel the trainer runs (merged table under windowed apply
+        # or the fused kernels, split under strict-xla at >10M rows —
+        # model_zoo/deepfm SPLIT_TABLE_ROWS).
         zoo.custom_model(
-            vocab_size=vocab, sparse_apply_every=sparse_apply_every
+            vocab_size=vocab, sparse_apply_every=sparse_apply_every,
+            sparse_kernel=sparse_kernel,
         ),
         zoo.loss,
         zoo.optimizer(),
         mesh,
         embedding_optimizer=embedding_optimizer or zoo.embedding_optimizer(),
         sparse_apply_every=sparse_apply_every,
+        sparse_kernel=sparse_kernel,
     )
     rng = np.random.RandomState(0)
 
@@ -219,6 +233,18 @@ def bench_deepfm(
     median, spread = _median_spread(times, batch_size * steps_per_window)
     n_chips = max(1, len(jax.devices()))
     return median / n_chips, spread
+
+
+def bench_deepfm_fused():
+    """The headline config (strict per-step, 2.6M rows) on the FUSED
+    Pallas sparse kernels (--sparse_kernel=fused, ops/sparse_embedding):
+    gather-and-lane-select lookup, one-pass dedup+apply, and the
+    DeepFM FM-interaction kernel — the ROADMAP-4 attack on the
+    `bound: sparse-row-count` wall.  Emitted tracked:false until a
+    driver run verifies the number on the chip (BASELINE.md round-6
+    queued chip work); the provisional baseline is the xla-strict
+    round-4 measurement, so vs_baseline > 1.0 IS the fused speedup."""
+    return bench_deepfm(sparse_kernel="fused")
 
 
 def bench_deepfm_table_scale():
@@ -721,6 +747,7 @@ def _roofline_fields(metric: str, value: float) -> dict:
         }
     if metric in (
         "deepfm_train_samples_per_sec_per_chip",
+        "deepfm_train_fused_samples_per_sec_per_chip",
         "deepfm_26m_table_samples_per_sec_per_chip",
         "deepfm_e2e_samples_per_sec_per_chip",
     ):
@@ -950,6 +977,19 @@ def main():
         strict_samples_per_sec,
         "samples/sec/chip",
         ss_spread,
+    )
+    fused_samples_per_sec, f_spread = bench_deepfm_fused()
+    _emit(
+        "deepfm_train_fused_samples_per_sec_per_chip",
+        fused_samples_per_sec,
+        "samples/sec/chip",
+        f_spread,
+        tracked=False,
+        untracked_reason=(
+            "fused kernels not yet chip-verified (BASELINE.md round-6 "
+            "queued chip work); flips tracked with the first driver "
+            "measurement"
+        ),
     )
     # The north-star headline prints LAST (the driver parses the final
     # line); final=True folds every metric of the run into its "all"

@@ -130,6 +130,40 @@ _SPEC_KWARGS = frozenset(
 )
 
 
+#: Constructor names whose arguments are *specifications*, not traced
+#: callables, even when the constructed object rides a POSITIONAL
+#: trace-entry argument (``pl.pallas_call(kernel, pl.GridSpec(...))``).
+#: Index-map lambdas inside BlockSpec/GridSpec run at trace SETUP on
+#: the host (shape math, np, closures all legal) — marking them traced
+#: false-positives every hot-path rule on kernel call sites.
+_SPEC_CONSTRUCTOR_NAMES = frozenset(
+    {
+        "BlockSpec",
+        "GridSpec",
+        "PrefetchScalarGridSpec",
+        "CompilerParams",
+        "InterpretParams",
+        "CostEstimate",
+        "ShapeDtypeStruct",
+    }
+)
+
+
+def _walk_skipping_spec_constructors(expr: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that prunes spec-constructor call subtrees (and their
+    index-map lambdas) out of trace-root marking."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if (
+            isinstance(node, ast.Call)
+            and _last_segment(node.func) in _SPEC_CONSTRUCTOR_NAMES
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
 def _last_segment(node: ast.AST) -> Optional[str]:
     """Last dotted segment of a Name/Attribute chain ('jax.lax.scan' ->
     'scan'), with leading underscores stripped ('_shard_map' ->
@@ -672,7 +706,7 @@ class TracedIndex:
                 if kw.arg not in _SPEC_KWARGS
             ]
             for expr in arg_exprs:
-                for sub in ast.walk(expr):
+                for sub in _walk_skipping_spec_constructors(expr):
                     if isinstance(sub, (ast.Name, ast.Attribute, ast.Lambda)):
                         resolved = self._resolve_ref(sub, ctx)
                         if resolved:

@@ -145,6 +145,19 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "batches outside a full window apply per-step.",
     )
     parser.add_argument(
+        "--sparse_kernel", default="auto", choices=["xla", "fused", "auto"],
+        help="ParameterServerStrategy sparse-path engine: 'xla' (packed "
+        "gather + one-hot select lookups, stream/scatter optimizer "
+        "apply) or 'fused' (the Pallas kernels in ops/sparse_embedding "
+        "— lookup, dedup+apply, and the DeepFM FM interaction keep "
+        "touched rows in VMEM instead of round-tripping [n, 128] HBM "
+        "intermediates; single-device tables only in v1, bit-exactness "
+        "contract in docs/design.md). 'auto' currently resolves to xla "
+        "— the fused kernels' chip numbers are queued driver work "
+        "(BASELINE.md) and auto never moves the headline onto "
+        "unmeasured code.",
+    )
+    parser.add_argument(
         "--oov_diagnostics", type=str2bool, nargs="?", const=True,
         default=False,
         help="Report per-step counts of embedding ids >= vocab_size in "

@@ -154,6 +154,14 @@ def load_model_spec(args) -> ModelSpec:
     _forward_flag(
         custom_model, model_params, "sparse_apply_every", job_w,
     )
+    # - sparse_kernel: lookup/FM engine selection for models that thread
+    #   it into their Embedding layers (deepfm); worker main also sets
+    #   the process default, so this forward only matters for the
+    #   layout-aware auto rules (deepfm merges its table under fused).
+    _forward_flag(
+        custom_model, model_params, "sparse_kernel",
+        getattr(args, "sparse_kernel", "auto") or "auto",
+    )
 
     return ModelSpec(
         module=module,

@@ -39,6 +39,7 @@ layer).
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import flax.linen as nn
@@ -121,6 +122,17 @@ class Embedding(nn.Module):
     padding (contribute zeros, receive no gradient).
     combiner: None returns per-position vectors [..., dim]; 'sum'/'mean'
     reduce the trailing length axis (the reference's sparse-input combiner).
+    sparse_kernel: 'xla' (the packed gather + one-hot select), 'fused'
+    (the Pallas gather-and-lane-select kernel,
+    ops/sparse_embedding.fused_lookup — bit-exact for in-vocab ids), or
+    'auto'; None consults the process default set from --sparse_kernel.
+    fm_interaction: combined-table FM mode (DeepFM): ids must be
+    [batch, fields] and __call__ returns ``(acts [batch, fields, dim],
+    first [batch], sum_v [batch, dim-1], sum_sq [batch, dim-1])`` where
+    lane 0 is the first-order weight and lanes 1..dim the FM field
+    vector — under the fused kernel the FM partial sums accumulate in
+    VMEM during the lookup pass, so the second-order term never
+    re-reads [batch, fields, dim] from HBM.
     """
 
     vocab_size: int
@@ -128,6 +140,8 @@ class Embedding(nn.Module):
     combiner: Optional[str] = None
     dtype: jnp.dtype = jnp.float32
     embeddings_initializer: Callable = default_embedding_init
+    sparse_kernel: Optional[str] = None
+    fm_interaction: bool = False
 
     @property
     def spec(self) -> PackedSpec:
@@ -186,13 +200,48 @@ class Embedding(nn.Module):
                 lambda c: None,
                 oov,
             )
+        from elasticdl_tpu.ops import sparse_embedding as ske
+
+        kernel = ske.resolve_kernel(self.sparse_kernel)
+        if self.fm_interaction:
+            if self.combiner is not None:
+                raise ValueError("fm_interaction excludes a combiner")
+            if ids.ndim != 2:
+                raise ValueError(
+                    "fm_interaction requires ids of shape [batch, fields]"
+                )
+            # The capture point moves INSIDE the fused op: `bet` is the
+            # perturbation variable itself (zeros at runtime), added to
+            # the looked-up rows BEFORE the validity mask — so padding
+            # positions still get zero gradient, and the FM partial
+            # sums' cotangents fold into the same captured gradient.
+            bet = self.perturb(
+                "bet",
+                jnp.zeros(safe_ids.shape + (self.embedding_dim,), self.dtype),
+            )
+            self.sow(IDS_COLLECTION, "ids", safe_ids)
+            if kernel == "fused":
+                return ske.fused_lookup_fm(spec, table, bet, safe_ids, valid)
+            acts = pk.lookup(spec, table, safe_ids.reshape((-1,))).reshape(
+                safe_ids.shape + (self.embedding_dim,)
+            )
+            acts = (acts + bet) * valid[..., None].astype(self.dtype)
+            first, sum_v, sum_sq = ske.fm_stats_xla(acts)
+            return acts, first, sum_v, sum_sq
         # NOTE: no stop_gradient here. Under the PS-mode trainer the table
         # is a closure constant of the loss (not a grad argument), so no
         # dense cotangent is ever built — the sparse path owns the update.
         # Under the Local/AllReduce trainers the table is a normal param
         # and trains by dense autodiff through the packed lookup (correct
-        # for the small tables those modes are meant for).
-        acts = pk.lookup(spec, table, safe_ids.reshape((-1,))).reshape(
+        # for the small tables those modes are meant for; the fused
+        # kernel's custom VJP carries the same sparse segment-sum
+        # cotangent).
+        lookup = (
+            functools.partial(ske.fused_lookup, spec, table)
+            if kernel == "fused"
+            else functools.partial(pk.lookup, spec, table)
+        )
+        acts = lookup(safe_ids.reshape((-1,))).reshape(
             safe_ids.shape + (self.embedding_dim,)
         )
         # Gradient capture point (the reference's tape.watch(bet)); must sit

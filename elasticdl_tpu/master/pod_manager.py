@@ -618,8 +618,8 @@ def worker_argv_from_args(args, master_addr: str) -> Callable[[int], List[str]]:
             "checkpoint_dir", "checkpoint_steps", "keep_checkpoint_max",
             "output", "use_bf16", "tensorboard_log_dir", "profile_steps",
             "train_window_steps", "dense_sharding", "mesh_model_axis",
-            "sparse_apply_every", "jax_compilation_cache_dir",
-            "oov_diagnostics",
+            "sparse_apply_every", "sparse_kernel",
+            "jax_compilation_cache_dir", "oov_diagnostics",
         },
     )
 

@@ -93,6 +93,12 @@ def transformer_flops_per_token(cfg: dict = TRANSFORMER_BENCH) -> float:
 MODEL_FLOPS: Dict[str, dict] = {
     # Dense tower is ~50k params; sparse row traffic is the wall
     # (26 embedding rows/sample — BENCH_r04 `bound: sparse-row-count`).
+    # The accounting is ENGINE-independent, so the verdict stays
+    # truthful under --sparse_kernel=fused too: rows/example is a model
+    # property and the 25 ns/row floor is the measured hardware bound
+    # on random 512 B row traffic — the fused Pallas kernels
+    # (ops/sparse_embedding.py) attack the engine's DISTANCE to that
+    # floor (floor_frac rises toward 1.0), not the floor itself.
     "deepfm": {
         "train_flops_per_example": 3 * 2 * 49_856.0,
         "sparse_rows_per_example": 26,

@@ -1,1 +1,2 @@
 from elasticdl_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from elasticdl_tpu.ops import sparse_embedding  # noqa: F401

@@ -1,0 +1,733 @@
+"""Fused Pallas TPU kernels for the sparse embedding hot path.
+
+BENCH_r04 named the perf ceiling: DeepFM trains at 972.9k samples/s/chip
+with ``bound: sparse-row-count`` (ns_per_row 39.5, floor_frac 0.632) —
+the lookup gather + one-hot select and the dedup+scatter optimizer
+update, not matmul, are the wall.  The XLA formulation of that path
+(parallel/packed.py + parallel/sparse_optim.py) round-trips several
+``[n, block_width]`` (512 B/row) intermediates through HBM per step:
+
+- ``pk.lookup``: gather full storage rows to an HBM ``[n, 128]`` buffer,
+  re-read it for the one-hot slot-select einsum, write ``[n, dim_pad]``;
+- ``scatter_apply`` (per optimizer): 2-4 such lookups for the slot rows
+  PLUS 3-4 ``expand_updates`` scatters, each materializing a tiled+
+  masked ``[n, 128]`` update operand before the full-row scatter-add.
+
+The kernels here keep the touched rows in VMEM between those steps
+instead (the same treatment ``ops/flash_attention.py`` gave the dense
+side — 2.4x on the transformer):
+
+``fused_lookup``       gather-and-lane-select in one kernel: each
+                       storage row is DMA'd HBM->VMEM once, the packed
+                       slot's lanes are selected with an EXACT f32
+                       dynamic slice (no MXU contraction, so no
+                       precision= escape hatch needed), and only the
+                       compact ``[n, dim_pad]`` result is written back.
+``fused_dedup_apply``  the optimizer update in one pass: the sort-free
+                       segment-combine (scatter-max representatives —
+                       the same mechanism as
+                       ``packed.dedup_representatives``, pinned
+                       bit-exact by tests) runs as a cheap O(n)
+                       prologue, then ONE kernel walks the touched
+                       representatives, DMAs table+slot rows into VMEM,
+                       applies sgd/momentum/adagrad/adam slot math in
+                       delta form (the scatter path's read-modify-write
+                       adds, <= 1 ulp — see its docstring), and DMAs
+                       the rows back — zero ``[n, 128]`` HBM
+                       intermediates.
+``fused_lookup_fm``    the DeepFM combined ``1+dim`` lookup feeding the
+                       FM second-order term: one pass emits the field
+                       activations (the deep tower needs them) AND the
+                       first-order sum + FM partial sums, so the FM
+                       term never re-reads the ``[batch, fields, dim]``
+                       tensor from HBM.  Differentiable via custom_vjp
+                       (the perturbation-capture input ``bet`` carries
+                       the sparse gradient, exactly like the unfused
+                       Embedding layer's capture point).
+
+Mode selection: the kernels are wired as a third ``fused`` mode behind
+``sparse_optim``'s stream/scatter switch and the ``--sparse_kernel
+{xla,fused,auto}`` job flag (threaded through ps_trainer, the Embedding
+layer, and the DeepFM zoo model).  ``auto`` currently resolves to
+``xla``: the fused path's chip numbers are queued driver work
+(BASELINE.md "queued chip work") and auto must not move the headline on
+unmeasured code — flip AUTO_FUSED_READY once the evidence lands.
+
+Every kernel runs in Pallas interpret mode off-TPU (same
+``_use_interpret()`` pattern as flash_attention), so tier-1 CPU tests
+exercise the real kernel bodies, and ``scripts/convergence_ab.py
+--sparse-kernel fused`` gates end-to-end training quality.
+
+Sharding caveat (v1): ``pl.pallas_call`` is not SPMD-partitionable the
+way the XLA gather/scatter ops are, so the fused mode targets tables
+resident on ONE device (the single-chip headline config).  On a
+multi-device mesh worker/main downgrades the whole job to xla before
+the model is built; a direct multi-device trainer construction with
+sparse_kernel='fused' is a config error (docs/design.md "Fused sparse
+kernels").  A shard_map-aware dispatch is the follow-up.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from elasticdl_tpu.parallel import packed as pk
+from elasticdl_tpu.parallel.packed import PackedSpec
+
+#: Ids processed per grid step.  VMEM cost per step is bounded by
+#: TILE x dim_padded f32 (the gsum / output tiles) + a double-buffered
+#: pair of 512 B row scratches — ~130 KB at the default, far under the
+#: ~16 MB scoped-VMEM budget (see docs/design.md "VMEM budget math").
+DEFAULT_IDS_PER_TILE = 128
+#: Batch rows per grid step of the FM kernel (x fields x (1+dim) f32 for
+#: the bet/acts tiles — 8 x 26 x 16 x 4 B = 13 KB at DeepFM shapes).
+DEFAULT_FM_BATCH_TILE = 8
+
+KERNELS = ("xla", "fused", "auto")
+
+#: Gate for auto mode: the fused kernels' chip numbers are queued driver
+#: work (BASELINE.md).  Until a driver bench verifies them, `auto`
+#: resolves to the measured xla path so the headline never silently
+#: moves onto unmeasured code.  Flip to True WITH the chip evidence.
+AUTO_FUSED_READY = False
+
+_DEFAULT_KERNEL = "xla"
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def set_default_kernel(kernel: str) -> None:
+    """Process-wide default consulted by Embedding layers whose model
+    did not thread ``sparse_kernel`` explicitly (worker main sets this
+    from ``--sparse_kernel`` before the model is built)."""
+    global _DEFAULT_KERNEL
+    if kernel not in KERNELS:
+        raise ValueError(f"sparse_kernel must be one of {KERNELS}, got {kernel!r}")
+    _DEFAULT_KERNEL = kernel
+
+
+def default_kernel() -> str:
+    return _DEFAULT_KERNEL
+
+
+def resolve_kernel(requested: Optional[str] = None) -> str:
+    """'xla' or 'fused' from a requested mode (None = process default).
+
+    ``auto`` prefers the fused kernels only once AUTO_FUSED_READY is
+    flipped by chip evidence (see module docstring); until then it IS
+    the xla path, logged once by ps_trainer at init.
+    """
+    kernel = requested or _DEFAULT_KERNEL
+    if kernel not in KERNELS:
+        raise ValueError(f"sparse_kernel must be one of {KERNELS}, got {kernel!r}")
+    if kernel == "auto":
+        return "fused" if AUTO_FUSED_READY else "xla"
+    return kernel
+
+
+# ----------------------------------------------------------------------
+# shared host-side prologue helpers
+# ----------------------------------------------------------------------
+
+
+def _pad_to_tile(n: int, tile: int) -> int:
+    return -(-n // tile) * tile
+
+
+def _block_and_lane(spec: PackedSpec, ids):
+    """(block_ids, lane0) int32 for the kernels' row DMA: storage block
+    CLAMPED to [0, num_blocks) — a deliberate choice for out-of-range
+    ids (every DMA must target a real row), NOT pk.lookup's semantics
+    there (its jnp.take default fill-mode reads NaN for OOB-high and
+    wraps negatives).  Bit-equivalence with pk.lookup therefore holds
+    for ids in [0, vocab_padded); out-of-range ids are the Embedding
+    layer's job (safe ids + validity mask), behind which the engines
+    are bit-identical — see fused_lookup's docstring and
+    tests/test_sparse_kernels.py.  The slot lane comes from floor-mod,
+    matching the one-hot select for every id."""
+    ids = ids.astype(jnp.int32)
+    r = spec.rows_per_block
+    blocks = jnp.clip(ids // r, 0, spec.num_blocks - 1)
+    lane0 = (ids % r) * spec.dim_padded
+    return blocks, lane0
+
+
+# ----------------------------------------------------------------------
+# fused lookup: gather + lane select in one kernel
+# ----------------------------------------------------------------------
+
+
+def _lookup_kernel(blocks_ref, lane0_ref, table_ref, out_ref, rows, sem,
+                   *, tile, dim_padded):
+    """One grid step: `tile` ids.  Per id, DMA its 512 B storage row
+    HBM->VMEM (double-buffered: row i+1's fetch overlaps row i's
+    select) and write only the slot's dim_padded lanes to the compact
+    output block."""
+    g = pl.program_id(0)
+
+    def fetch(i, slot):
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(blocks_ref[g * tile + i], 1), :],
+            rows.at[slot],
+            sem.at[slot],
+        )
+
+    fetch(0, 0).start()
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < tile)
+        def _prefetch():
+            fetch(i + 1, 1 - slot).start()
+
+        fetch(i, slot).wait()
+        row = rows[slot, 0, :]
+        sel = jax.lax.dynamic_slice(
+            row, (lane0_ref[g * tile + i],), (dim_padded,)
+        )
+        out_ref[pl.ds(i, 1), :] = sel[None, :]
+        return 0
+
+    jax.lax.fori_loop(0, tile, body, 0)
+
+
+def _lookup_impl(spec: PackedSpec, interpret: bool, tile: int, packed, ids):
+    n = ids.shape[0]
+    if n == 0:
+        return jnp.zeros((0, spec.dim), packed.dtype)
+    tile = min(tile, _pad_to_tile(n, 8))
+    n_pad = _pad_to_tile(n, tile)
+    ids_pad = jnp.pad(ids.astype(jnp.int32), (0, n_pad - n))
+    blocks, lane0 = _block_and_lane(spec, ids_pad)
+    out = pl.pallas_call(
+        functools.partial(
+            _lookup_kernel, tile=tile, dim_padded=spec.dim_padded
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n_pad // tile,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(
+                (tile, spec.dim_padded), lambda g, *_: (g, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, 1, spec.block_width), packed.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, spec.dim_padded), packed.dtype),
+        interpret=interpret,
+    )(blocks, lane0, packed)
+    return out[:n, : spec.dim]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _lookup_diff(spec, interpret, tile, packed, ids):
+    return _lookup_impl(spec, interpret, tile, packed, ids)
+
+
+def _lookup_fwd(spec, interpret, tile, packed, ids):
+    out = _lookup_impl(spec, interpret, tile, packed, ids)
+    return out, ids
+
+
+def _lookup_bwd(spec, interpret, tile, ids, g):
+    # Same cotangent the packed scatter path owns: duplicate ids sum,
+    # out-of-range ids drop.  (pk.lookup's fill-mode backward would
+    # drop/wrap OOV cotangents differently, but every caller masks
+    # invalid positions to zero gradient first — the Embedding layer's
+    # validity mask — so the two backwards agree where gradients are
+    # nonzero.)
+    d_packed = pk.grad_accumulate(
+        spec, jnp.zeros(spec.packed_shape, g.dtype), ids, g
+    )
+    return d_packed, jnp.zeros(ids.shape, jax.dtypes.float0)
+
+
+_lookup_diff.defvjp(_lookup_fwd, _lookup_bwd)
+
+
+def fused_lookup(
+    spec: PackedSpec,
+    packed,
+    ids,
+    *,
+    interpret: Optional[bool] = None,
+    tile: int = DEFAULT_IDS_PER_TILE,
+):
+    """Drop-in for ``packed.lookup``: ids [n] int32 -> [n, dim].
+
+    Bit-exact vs pk.lookup for every id in ``[0, vocab_padded)`` (the
+    one-hot einsum at precision=HIGHEST is an exact f32 select; so is
+    the kernel's lane slice).  Out-of-range ids — which every caller
+    masks BEFORE the lookup (the Embedding layer's safe-id contract) —
+    read a clamped storage row here, where pk.lookup's jnp.take
+    fill-mode reads NaN (OOB-high) or wraps (negative); through the
+    Embedding layer the two paths are bit-identical because the
+    validity mask zeroes those positions either way (pinned by
+    tests/test_sparse_kernels.py).  Differentiable in the table
+    (sparse segment-sum cotangent).
+    """
+    interpret = _use_interpret() if interpret is None else interpret
+    return _lookup_diff(spec, interpret, tile, packed, ids)
+
+
+# ----------------------------------------------------------------------
+# fused dedup + optimizer apply
+# ----------------------------------------------------------------------
+
+#: Table-shaped operands per optimizer kind, in kernel-operand order.
+#: The table itself is always first; the rest are the slot names.
+_KIND_SLOTS: Dict[str, Tuple[str, ...]] = {
+    "sgd": (),
+    "momentum": ("momentum",),
+    "adagrad": ("accumulator",),
+    "adam": ("m", "v", "t"),
+    "adam_global": ("m", "v"),
+}
+
+
+def _apply_math(kind, hyper, lane_mask, g, subs, tr):
+    """Per-representative optimizer math on dim_padded lane vectors.
+
+    Returns the DELTAS to add to each operand's lanes (table first, then
+    slots in _KIND_SLOTS order) — delta form so the written values are
+    bit-identical to the scatter path's read-modify-write adds.  `g` is
+    the summed gradient (pad lanes zero), `subs` the current lane
+    vectors, `tr` the adam bias-correction step count (scalar).
+    """
+    lr = hyper["learning_rate"]
+    if kind == "sgd":
+        return (-lr * g,)
+    if kind == "momentum":
+        mu = hyper["momentum"]
+        v = subs[1]
+        v_new = mu * v + g
+        step = (mu * v_new + g) if hyper["nesterov"] else v_new
+        return (-lr * step, v_new - v)
+    if kind == "adagrad":
+        eps = hyper["epsilon"]
+        acc = subs[1]
+        gg = g * g
+        new_acc = acc + gg
+        update = -lr * g / (jnp.sqrt(new_acc) + eps)
+        return (update, gg)
+    # adam / adam_global
+    b1, b2, eps = hyper["beta_1"], hyper["beta_2"], hyper["epsilon"]
+    m, v = subs[1], subs[2]
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    m_hat = m_new / (1 - b1 ** tr)
+    v_hat = v_new / (1 - b2 ** tr)
+    update = -lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    if kind == "adam":
+        # Per-row t increments by 1 on REAL lanes only (pad lanes stay
+        # zero — the packed-invariant the scatter path keeps too).
+        return (update, m_new - m, v_new - v, lane_mask)
+    return (update, m_new - m, v_new - v)
+
+
+def _dedup_apply_kernel(blocks_ref, lane0_ref, touched_ref, gsum_ref,
+                        tr_ref, *refs, kind, hyper, tile, dim_padded,
+                        dim, n_tables):
+    """Grid step over `tile` representatives.  For each touched one:
+    DMA the table row + slot rows HBM->VMEM (all fetches in flight
+    together), apply the optimizer math to the slot's lanes, DMA the
+    updated rows back.  The TPU grid is sequential, so two
+    representatives sharing a storage row serialize correctly."""
+    # refs layout: n_tables ANY-space input refs, n_tables output refs
+    # (input_output_aliases makes each pair one buffer — read and write
+    # through the OUTPUT ref), then scratch: rows VMEM
+    # [n_tables, 1, block_width] and the in/out DMA semaphores.
+    tables = refs[n_tables : 2 * n_tables]
+    rows, sem_in, sem_out = refs[2 * n_tables :]
+    g = pl.program_id(0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, dim_padded), 1)[0]
+    lane_mask = (lane < dim).astype(gsum_ref.dtype)
+
+    def body(i, _):
+        pos = g * tile + i
+
+        @pl.when(touched_ref[pos] != 0)
+        def _apply():
+            block = blocks_ref[pos]
+            lane0 = lane0_ref[pos]
+            for t in range(n_tables):
+                pltpu.make_async_copy(
+                    tables[t].at[pl.ds(block, 1), :],
+                    rows.at[t],
+                    sem_in.at[t],
+                ).start()
+            for t in range(n_tables):
+                pltpu.make_async_copy(
+                    tables[t].at[pl.ds(block, 1), :],
+                    rows.at[t],
+                    sem_in.at[t],
+                ).wait()
+            subs = tuple(
+                jax.lax.dynamic_slice(
+                    rows[t, 0, :], (lane0,), (dim_padded,)
+                )
+                for t in range(n_tables)
+            )
+            gvec = gsum_ref[i, :]
+            tr = tr_ref[0, 0]
+            if kind == "adam":
+                # Scatter-path contract: tr = max(t_before + 1, 1) read
+                # from the count slot's first real lane.
+                tr = jnp.maximum(subs[3][0] + 1.0, 1.0)
+            deltas = _apply_math(kind, hyper, lane_mask, gvec, subs, tr)
+            for t in range(n_tables):
+                updated = jax.lax.dynamic_update_slice(
+                    rows[t, 0, :], subs[t] + deltas[t], (lane0,)
+                )
+                rows[t, 0, :] = updated
+                pltpu.make_async_copy(
+                    rows.at[t],
+                    tables[t].at[pl.ds(block, 1), :],
+                    sem_out.at[t],
+                ).start()
+            for t in range(n_tables):
+                pltpu.make_async_copy(
+                    rows.at[t],
+                    tables[t].at[pl.ds(block, 1), :],
+                    sem_out.at[t],
+                ).wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, tile, body, 0)
+
+
+def fused_dedup_apply(
+    spec: PackedSpec,
+    kind: str,
+    hyper: dict,
+    packed_table,
+    slots: dict,
+    ids,
+    grads,
+    *,
+    interpret: Optional[bool] = None,
+    tile: int = DEFAULT_IDS_PER_TILE,
+):
+    """One-pass sparse optimizer step: ``(ids, grads)`` in,
+    ``(new_table, new_slots)`` out, matching
+    ``dedup_representatives + scatter_apply``.
+
+    Exactness contract (pinned by tests/test_sparse_kernels.py): the
+    kernel replays the scatter path's arithmetic operation-for-
+    operation — the same segment-combined gradients (identical bits:
+    the dedup prologue IS the scatter path's), the same elementwise
+    slot math, and delta-form writes (``old + fl(new - old)``, the
+    scatter path's read-modify-write adds).  In exact arithmetic the
+    two are identical; in compiled f32 they agree to <= 1 ulp, because
+    XLA is free to fuse any multiply-feeding-an-add into an FMA (one
+    rounding) on either side of the comparison and no kernel
+    formulation can pin which.  Documented tolerance: rtol 3e-7
+    (observed diffs: 0 on most elements, 1 ulp on the rest — e.g.
+    adagrad's ``acc + g*g`` inside the update chain).
+
+    The sort-free segment-combine (two O(n) scatters; the SAME
+    scatter-max mechanism the scatter path uses, so the summed
+    gradients carry identical bits) runs as an XLA prologue; the
+    gather/update/scatter trips it used to feed — 2-4 packed lookups
+    plus 3-4 expand_updates scatters, each an ``[n, 128]`` HBM
+    intermediate — collapse into one kernel that round-trips only the
+    touched rows' 512 B storage rows through VMEM.
+    """
+    if kind == "adam" and "t" not in slots:
+        kind = "adam_global"
+    if kind not in _KIND_SLOTS:
+        raise ValueError(f"unknown sparse optimizer kind {kind!r}")
+    interpret = _use_interpret() if interpret is None else interpret
+    slot_names = _KIND_SLOTS[kind]
+    new_slots = dict(slots)
+
+    safe, gsum, touched = pk.dedup_representatives(spec, ids, grads)
+    tch = touched.astype(packed_table.dtype)[:, None]
+    gsum = gsum * tch  # the scatter path's masking, same bits
+
+    n = safe.shape[0]
+    tile = min(tile, _pad_to_tile(max(n, 1), 8))
+    n_pad = _pad_to_tile(max(n, 1), tile)
+    pad = n_pad - n
+    safe_pad = jnp.pad(safe, (0, pad))
+    touched_pad = jnp.pad(touched.astype(jnp.int32), (0, pad))
+    blocks, lane0 = _block_and_lane(spec, safe_pad)
+    if spec.dim != spec.dim_padded:
+        gsum = jnp.pad(gsum, ((0, 0), (0, spec.dim_padded - spec.dim)))
+    gsum_pad = jnp.pad(gsum, ((0, pad), (0, 0)))
+
+    if kind == "adam_global":
+        # Global bias correction: one shared apply counter, incremented
+        # unconditionally per apply (the reference Go Adam's contract).
+        t_global = slots["t_global"] + 1.0
+        new_slots["t_global"] = t_global
+        tr = jnp.reshape(t_global.astype(jnp.float32), (1, 1))
+    else:
+        tr = jnp.zeros((1, 1), jnp.float32)  # per-row tr reads in-kernel
+
+    tables = (packed_table,) + tuple(slots[name] for name in slot_names)
+    n_tables = len(tables)
+    # Operand order: 3 prefetch scalars, gsum tile, tr scalar, then the
+    # aliased table refs.  input_output_aliases indexes INCLUDE the
+    # prefetch operands.
+    aliases = {5 + t: t for t in range(n_tables)}
+    outs = pl.pallas_call(
+        functools.partial(
+            _dedup_apply_kernel,
+            kind=kind,
+            hyper=hyper,
+            tile=tile,
+            dim_padded=spec.dim_padded,
+            dim=spec.dim,
+            n_tables=n_tables,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(n_pad // tile,),
+            in_specs=[
+                pl.BlockSpec((tile, spec.dim_padded), lambda g, *_: (g, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ]
+            + [pl.BlockSpec(memory_space=pltpu.ANY)] * n_tables,
+            out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * n_tables,
+            scratch_shapes=[
+                pltpu.VMEM(
+                    (n_tables, 1, spec.block_width), packed_table.dtype
+                ),
+                pltpu.SemaphoreType.DMA((n_tables,)),
+                pltpu.SemaphoreType.DMA((n_tables,)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(t.shape, t.dtype) for t in tables
+        ],
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(blocks, lane0, touched_pad, gsum_pad, tr, *tables)
+    new_table = outs[0]
+    for name, arr in zip(slot_names, outs[1:]):
+        new_slots[name] = arr
+    return new_table, new_slots
+
+
+# ----------------------------------------------------------------------
+# fused lookup -> FM interaction (DeepFM's combined 1+dim table)
+# ----------------------------------------------------------------------
+
+
+def _fm_kernel(blocks_ref, lane0_ref, bet_ref, valid_ref, table_ref,
+               acts_ref, first_ref, sumv_ref, sumsq_ref, rows, sem,
+               *, batch_tile, fields, dim):
+    """Grid step over `batch_tile` examples x `fields` ids: DMA each
+    field's storage row once, add the perturbation capture, mask
+    validity, and accumulate the first-order sum + FM partial sums in
+    VMEM registers while the activations stream to their output block —
+    the FM term never re-reads [batch, fields, dim] from HBM."""
+    g = pl.program_id(0)
+
+    def fetch(pos, slot):
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(blocks_ref[pos], 1), :],
+            rows.at[slot],
+            sem.at[slot],
+        )
+
+    def example(b, _):
+        base = (g * batch_tile + b) * fields
+        fetch(base, 0).start()
+
+        def field(f, carry):
+            first, sv, ss = carry
+            slot = jax.lax.rem(f, 2)
+
+            @pl.when(f + 1 < fields)
+            def _prefetch():
+                fetch(base + f + 1, 1 - slot).start()
+
+            fetch(base + f, slot).wait()
+            sel = jax.lax.dynamic_slice(
+                rows[slot, 0, :], (lane0_ref[base + f],), (dim,)
+            )
+            a = (sel + bet_ref[b, f, :]) * valid_ref[b, f]
+            acts_ref[b, f, :] = a
+            v = a[1:]
+            return first + a[0], sv + v, ss + v * v
+
+        first, sv, ss = jax.lax.fori_loop(
+            0,
+            fields,
+            field,
+            (
+                jnp.zeros((), acts_ref.dtype),
+                jnp.zeros((dim - 1,), acts_ref.dtype),
+                jnp.zeros((dim - 1,), acts_ref.dtype),
+            ),
+        )
+        first_ref[b, 0] = first
+        sumv_ref[b, :] = sv
+        sumsq_ref[b, :] = ss
+        return 0
+
+    jax.lax.fori_loop(0, batch_tile, example, 0)
+
+
+def _fm_impl(spec, interpret, batch_tile, packed, bet, ids, valid):
+    batch, fields = ids.shape
+    dim = spec.dim
+    batch_tile = min(batch_tile, max(batch, 1))
+    b_pad = _pad_to_tile(max(batch, 1), batch_tile)
+    pad = b_pad - batch
+    ids_pad = jnp.pad(ids.astype(jnp.int32), ((0, pad), (0, 0)))
+    blocks, lane0 = _block_and_lane(spec, ids_pad.reshape((-1,)))
+    bet_pad = jnp.pad(
+        bet.astype(packed.dtype), ((0, pad), (0, 0), (0, 0))
+    )
+    valid_pad = jnp.pad(
+        valid.astype(packed.dtype), ((0, pad), (0, 0))
+    )
+    acts, first, sumv, sumsq = pl.pallas_call(
+        functools.partial(
+            _fm_kernel, batch_tile=batch_tile, fields=fields, dim=dim
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b_pad // batch_tile,),
+            in_specs=[
+                pl.BlockSpec(
+                    (batch_tile, fields, dim), lambda g, *_: (g, 0, 0)
+                ),
+                pl.BlockSpec((batch_tile, fields), lambda g, *_: (g, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (batch_tile, fields, dim), lambda g, *_: (g, 0, 0)
+                ),
+                pl.BlockSpec((batch_tile, 1), lambda g, *_: (g, 0)),
+                pl.BlockSpec((batch_tile, dim - 1), lambda g, *_: (g, 0)),
+                pl.BlockSpec((batch_tile, dim - 1), lambda g, *_: (g, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, 1, spec.block_width), packed.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b_pad, fields, dim), packed.dtype),
+            jax.ShapeDtypeStruct((b_pad, 1), packed.dtype),
+            jax.ShapeDtypeStruct((b_pad, dim - 1), packed.dtype),
+            jax.ShapeDtypeStruct((b_pad, dim - 1), packed.dtype),
+        ],
+        interpret=interpret,
+    )(blocks, lane0, bet_pad, valid_pad, packed)
+    return (
+        acts[:batch],
+        first[:batch, 0],
+        sumv[:batch],
+        sumsq[:batch],
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _fm_diff(spec, interpret, batch_tile, packed, bet, ids, valid):
+    return _fm_impl(spec, interpret, batch_tile, packed, bet, ids, valid)
+
+
+def _fm_fwd(spec, interpret, batch_tile, packed, bet, ids, valid):
+    out = _fm_impl(spec, interpret, batch_tile, packed, bet, ids, valid)
+    acts = out[0]
+    return out, (acts, ids, valid)
+
+
+def _fm_bwd(spec, interpret, batch_tile, res, cots):
+    acts, ids, valid = res
+    dtype = acts.dtype
+    d_acts, d_first, d_sumv, d_sumsq = cots
+    # acts = (row + bet) * valid; first/sum_v/sum_sq are plain sums of
+    # acts components, so every cotangent folds into one per-field
+    # activation cotangent (the 2*v term is the sum-of-squares
+    # jacobian) — the same quantity the unfused layer's perturbation
+    # capture would receive.
+    d_field = d_acts.astype(dtype)
+    d_field = d_field.at[..., 0].add(d_first.astype(dtype)[:, None])
+    d_field = d_field.at[..., 1:].add(
+        d_sumv.astype(dtype)[:, None, :]
+        + 2.0 * acts[..., 1:] * d_sumsq.astype(dtype)[:, None, :]
+    )
+    d_field = d_field * valid.astype(dtype)[..., None]
+    d_packed = pk.grad_accumulate(
+        spec,
+        jnp.zeros(spec.packed_shape, dtype),
+        ids.reshape((-1,)),
+        d_field.reshape((-1, spec.dim)),
+    )
+    return (
+        d_packed,
+        d_field,
+        jnp.zeros(ids.shape, jax.dtypes.float0),
+        jnp.zeros(valid.shape, jax.dtypes.float0),
+    )
+
+
+_fm_diff.defvjp(_fm_fwd, _fm_bwd)
+
+
+def fused_lookup_fm(
+    spec: PackedSpec,
+    packed,
+    bet,
+    ids,
+    valid,
+    *,
+    interpret: Optional[bool] = None,
+    batch_tile: int = DEFAULT_FM_BATCH_TILE,
+):
+    """Combined ``1+dim`` lookup + FM partial sums in one pass.
+
+    ids [batch, fields] int32 (already offset), valid [batch, fields]
+    bool, bet [batch, fields, dim] — the perturbation-capture variable
+    (zeros at runtime; its cotangent IS the sparse gradient).  Returns
+    ``(acts [batch, fields, dim], first [batch], sum_v [batch, dim-1],
+    sum_sq [batch, dim-1])`` where acts lane 0 is the first-order
+    weight and lanes 1..dim the FM field vector:
+
+        second_order = 0.5 * sum_d(sum_v^2 - sum_sq)
+
+    composable with dense-field sums (DeepFM adds its 13 projected
+    numeric fields before squaring).  The activations are emitted for
+    the deep tower; the FM sums accumulate in VMEM during the same
+    pass, so the ``[batch, fields, dim]`` tensor is written once and
+    never re-read on the FM path.  ``fm_stats_xla`` is the reference
+    twin (same contract, XLA ops) — the two agree on acts bit-for-bit
+    and on the sums to reduction-order tolerance (documented in
+    docs/design.md).
+    """
+    if spec.dim < 2:
+        raise ValueError(
+            f"fused_lookup_fm needs a combined table of dim >= 2 "
+            f"(1 linear lane + FM lanes), got dim={spec.dim}"
+        )
+    interpret = _use_interpret() if interpret is None else interpret
+    return _fm_diff(spec, interpret, batch_tile, packed, bet, ids, valid)
+
+
+def fm_stats_xla(acts):
+    """The XLA twin of fused_lookup_fm's statistics: acts
+    [batch, fields, dim] -> (first, sum_v, sum_sq).  Same contract;
+    jnp reductions instead of the kernel's sequential field loop (the
+    documented reduction-order tolerance between the two)."""
+    first = jnp.sum(acts[..., 0], axis=-1)
+    v = acts[..., 1:]
+    return first, jnp.sum(v, axis=1), jnp.sum(v * v, axis=1)

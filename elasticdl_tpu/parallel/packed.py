@@ -290,7 +290,11 @@ def _slot_mask(spec: PackedSpec, ids):
 
 
 def dedup_representatives(spec: PackedSpec, ids, grads):
-    """Sort-free dedup of (ids, grads) for lazy row-wise optimizers.
+    """Sort-free dedup of (ids, grads) for lazy row-wise optimizers —
+    ALSO the segment-combine prologue of the fused Pallas apply
+    (ops/sparse_embedding.fused_dedup_apply), which consumes
+    (safe, gsum, touched) directly so both engines see identical
+    summed-gradient bits.
 
     Returns (safe_ids [n] int32, gsum [n, dim], touched [n] bool) where
     exactly ONE position per distinct in-bounds id — its last occurrence,

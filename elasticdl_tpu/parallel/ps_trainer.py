@@ -93,6 +93,7 @@ class ShardedEmbeddingTrainer:
         embedding_optimizer: Optional[SparseOptimizer] = None,
         seed: int = 0,
         sparse_apply_every=1,
+        sparse_kernel: Optional[str] = None,
     ):
         self._model = model
         self._loss_fn = loss_fn
@@ -105,6 +106,46 @@ class ShardedEmbeddingTrainer:
             )
             embedding_optimizer = sgd(0.01)
         self._emb_tx = embedding_optimizer
+        # --sparse_kernel: 'fused' swaps the optimizer's apply onto the
+        # Pallas dedup+apply kernel (ops/sparse_embedding.py); the
+        # LOOKUP side rides the model's own Embedding layers (the zoo
+        # threads the flag via model_params; worker main also sets the
+        # process default so un-threaded models follow).  None = the
+        # process default; 'auto' resolves there (xla until the fused
+        # chip numbers land — BASELINE.md queued chip work).
+        from elasticdl_tpu.ops import sparse_embedding as ske
+
+        self._sparse_kernel_requested = sparse_kernel or ske.default_kernel()
+        resolved = ske.resolve_kernel(sparse_kernel)
+        if resolved == "fused" and int(mesh.devices.size) > 1:
+            # Config ERROR, not a silent fallback: pallas_call is not
+            # SPMD-partitionable, and the trainer cannot retro-switch
+            # the MODEL's Embedding layers (built with their own
+            # sparse_kernel), so "falling back" here would run fused
+            # lookups over a sharded table anyway while journaling
+            # kernel=xla — the misattribution the journal event exists
+            # to prevent.  worker/main downgrades the whole job (layers
+            # + optimizer + journal) consistently BEFORE the model is
+            # built; direct constructions must pick one engine.
+            raise ValueError(
+                f"sparse_kernel=fused on a {int(mesh.devices.size)}-device "
+                "mesh: the fused kernels target single-device tables "
+                "(v1; pallas_call has no SPMD partitioning rule — "
+                "docs/design.md 'Fused sparse kernels'). Use "
+                "sparse_kernel='xla' (and build the model with the same "
+                "kernel), or a single-device mesh."
+            )
+        if resolved == "fused":
+            if self._emb_tx.remake is None:
+                logger.warning(
+                    "sparse_kernel=fused but embedding optimizer %r has "
+                    "no remake hook; its apply keeps its constructed "
+                    "mode (lookups still run fused)",
+                    self._emb_tx.name,
+                )
+            else:
+                self._emb_tx = self._emb_tx.remake("fused")
+        self._sparse_kernel = resolved
         if sparse_apply_every == "auto":
             # Resolved at ensure_initialized, the first point the
             # resident table row count is known (AUTO_APPLY_TABLE_ROWS
@@ -338,12 +379,27 @@ class ShardedEmbeddingTrainer:
         logger.info(
             "Initialized PS-mode model: %d dense params (replicated), "
             "%d embedding-table params in %d table(s) sharded over %d "
-            "device(s) [%s]",
+            "device(s) [%s, sparse_kernel=%s]",
             n_dense,
             n_table,
             len(tables),
             self._mesh.devices.size,
             self._emb_tx.name,
+            self._sparse_kernel,
+        )
+        # Journal the kernel decision (host-side, init-time — the obs
+        # plane never rides the traced step): postmortems and the
+        # bench-regress audit trail need to know WHICH engine a number
+        # was measured on (schema: scripts/validate_journal.py).
+        from elasticdl_tpu import obs
+
+        obs.journal().record(
+            "sparse_kernel_selected",
+            kernel=self._sparse_kernel,
+            requested=self._sparse_kernel_requested,
+            optimizer=self._emb_tx.name,
+            tables=len(tables),
+            table_rows=total_rows,
         )
         self._compile_steps()
         return self._state

@@ -29,9 +29,18 @@ TPU design (round 2 rewrite — the round-1 version cost 2.9x):
     O(batch) instead of O(table): at the north-star 26M resident rows the
     streaming pass had collapsed DeepFM from 839k to 192k samples/s; this
     path removes the table-size term entirely.
+  * FUSED (round 6, opt-in via --sparse_kernel): the scatter path's
+    gather/update/scatter trips collapsed into one Pallas kernel
+    (ops/sparse_embedding.fused_dedup_apply) that keeps each touched
+    row in VMEM between the dedup, the slot math, and the write-back —
+    none of the [n, 128] HBM intermediates the XLA formulation
+    materializes.  Bit-exact vs the scatter path for adagrad/adam
+    (1-ulp documented tolerance on sgd/momentum table writes — see the
+    kernel docstring).
   The auto crossover (streaming below ~8 batch-sized table passes,
   scatter above) is set from measurements on the v5e chip; see
-  _use_scatter below.
+  _use_scatter below.  `auto` never selects FUSED on its own until its
+  chip numbers land (BASELINE.md queued chip work).
 
 Semantics (identical to round 1 and to the TF sparse-apply contract):
 - Duplicate ids within a step contribute their SUMMED gradient and cause
@@ -80,6 +89,12 @@ class SparseOptimizer:
     # step (BASELINE.md).  apply_acc serves host-side/offline applies and
     # callers that already hold an accumulated gradient table.
     apply_acc: Optional[Callable] = None
+    # remake(mode) -> SparseOptimizer: this optimizer rebuilt with a
+    # different apply-mode but identical hyperparameters.  The trainer
+    # uses it to honor --sparse_kernel=fused on an optimizer the model
+    # spec constructed with the default mode (ps_trainer can't mutate a
+    # frozen dataclass whose apply closures captured the mode).
+    remake: Optional[Callable[[str], "SparseOptimizer"]] = None
 
     # -- logical-shape conveniences (tests, host tools) -----------------
 
@@ -129,34 +144,76 @@ def _use_scatter(spec: PackedSpec, n_ids: int, mode: str) -> bool:
     return spec.num_blocks > _SCATTER_CROSSOVER * n_ids
 
 
-def _dual_apply(mode: str, stream_apply_acc, scatter_apply):
+def select_mode(spec: PackedSpec, n_ids: int, mode: str) -> str:
+    """'stream' | 'scatter' | 'fused' for one apply.  `fused` routes the
+    whole update through the Pallas dedup+apply kernel
+    (ops/sparse_embedding.py); `auto` keeps the measured stream/scatter
+    crossover and never picks fused on its own — the fused kernels'
+    chip numbers are queued driver work (BASELINE.md), so fused stays
+    opt-in (--sparse_kernel) until the evidence lands."""
+    if mode == "fused":
+        return "fused"
+    return (
+        "scatter" if _use_scatter(spec, n_ids, mode) else "stream"
+    )
+
+
+def _fused_apply(kind: str, hyper: dict):
+    """apply() via the fused Pallas dedup+apply kernel.  Import at
+    construction time (host), not trace time."""
+    from elasticdl_tpu.ops import sparse_embedding as ske
+
+    def apply(spec, packed_table, slots, ids, grads):
+        return ske.fused_dedup_apply(
+            spec, kind, hyper, packed_table, slots, ids, grads
+        )
+
+    return apply
+
+
+def _dual_apply(mode: str, stream_apply_acc, scatter_apply,
+                fused_apply=None):
     """The apply dispatcher shared by every slotted optimizer: streaming
-    (grad_accumulate + the acc-consuming core) vs touched-rows scatter,
-    chosen per _use_scatter."""
+    (grad_accumulate + the acc-consuming core), touched-rows scatter, or
+    the fused Pallas kernel — chosen per select_mode."""
 
     def stream_apply(spec, packed_table, slots, ids, grads):
         acc = pk.grad_accumulate(spec, packed_table, ids, grads)
         return stream_apply_acc(spec, packed_table, slots, acc)
 
+    impls = {
+        "stream": stream_apply,
+        "scatter": scatter_apply,
+        "fused": fused_apply,
+    }
+
     def apply(spec, packed_table, slots, ids, grads):
-        impl = (
-            scatter_apply
-            if _use_scatter(spec, ids.shape[0], mode)
-            else stream_apply
-        )
+        impl = impls[select_mode(spec, ids.shape[0], mode)]
+        if impl is None:
+            raise ValueError("this optimizer has no fused kernel path")
         return impl(spec, packed_table, slots, ids, grads)
 
     return apply
 
 
-def sgd(learning_rate: float = 0.01) -> SparseOptimizer:
+def sgd(learning_rate: float = 0.01, mode: str = "auto") -> SparseOptimizer:
     lr = learning_rate
+    hyper = {"learning_rate": lr}
 
     def init_slots(spec, packed_table):
         return {}
 
-    def apply(spec, packed_table, slots, ids, grads):
+    def scatter_or_stream_apply(spec, packed_table, slots, ids, grads):
+        # SGD is linear in the gradient, so one scatter-add IS both the
+        # stream and the scatter path — no dedup needed.
         return pk.scatter_add(spec, packed_table, ids, -lr * grads), slots
+
+    fused = _fused_apply("sgd", hyper)
+
+    def apply(spec, packed_table, slots, ids, grads):
+        if select_mode(spec, ids.shape[0], mode) == "fused":
+            return fused(spec, packed_table, slots, ids, grads)
+        return scatter_or_stream_apply(spec, packed_table, slots, ids, grads)
 
     def apply_acc(spec, packed_table, slots, acc):
         # SGD is linear in the gradient, so the windowed apply is EXACTLY
@@ -164,7 +221,8 @@ def sgd(learning_rate: float = 0.01) -> SparseOptimizer:
         return packed_table - lr * acc, slots
 
     return SparseOptimizer(
-        "sgd", init_slots, apply, {"learning_rate": lr}, apply_acc
+        "sgd", init_slots, apply, hyper, apply_acc,
+        remake=lambda m: sgd(learning_rate, mode=m),
     )
 
 
@@ -202,11 +260,14 @@ def momentum(
         new_table = pk.scatter_add(spec, packed_table, uids, -lr * tch * step)
         return new_table, {"momentum": new_v}
 
+    hyper = {"learning_rate": lr, "momentum": mu, "nesterov": nesterov}
     return SparseOptimizer(
         "momentum", init_slots,
-        _dual_apply(mode, stream_apply_acc, scatter_apply),
-        {"learning_rate": lr, "momentum": mu, "nesterov": nesterov},
+        _dual_apply(mode, stream_apply_acc, scatter_apply,
+                    _fused_apply("momentum", hyper)),
+        hyper,
         stream_apply_acc,
+        remake=lambda m: momentum(learning_rate, mu, nesterov, mode=m),
     )
 
 
@@ -234,11 +295,14 @@ def adagrad(
         new_table = pk.scatter_add(spec, packed_table, uids, update)
         return new_table, {"accumulator": new_acc}
 
+    hyper = {"learning_rate": lr, "epsilon": epsilon}
     return SparseOptimizer(
         "adagrad", init_slots,
-        _dual_apply(mode, stream_apply_acc, scatter_apply),
-        {"learning_rate": lr, "epsilon": epsilon},
+        _dual_apply(mode, stream_apply_acc, scatter_apply,
+                    _fused_apply("adagrad", hyper)),
+        hyper,
         stream_apply_acc,
+        remake=lambda m: adagrad(learning_rate, epsilon, mode=m),
     )
 
 
@@ -340,12 +404,18 @@ def adam(
         new_table = pk.scatter_add(spec, packed_table, uids, update)
         return new_table, new_slots
 
+    hyper = {"learning_rate": lr, "beta_1": beta_1, "beta_2": beta_2,
+             "epsilon": epsilon, "bias_correction": bias_correction}
     return SparseOptimizer(
         "adam", init_slots,
-        _dual_apply(mode, stream_apply_acc, scatter_apply),
-        {"learning_rate": lr, "beta_1": beta_1, "beta_2": beta_2,
-         "epsilon": epsilon, "bias_correction": bias_correction},
+        _dual_apply(mode, stream_apply_acc, scatter_apply,
+                    _fused_apply("adam", hyper)),
+        hyper,
         stream_apply_acc,
+        remake=lambda m: adam(
+            learning_rate, beta_1, beta_2, epsilon, mode=m,
+            bias_correction=bias_correction,
+        ),
     )
 
 

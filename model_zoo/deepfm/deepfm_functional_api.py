@@ -43,11 +43,30 @@ class DeepFM(nn.Module):
     vocab_size: int = VOCAB
     embedding_dim: int = 8
     hidden: int = 128
-    # Per-mode table layout: None = auto (split under strict per-step
-    # sparse apply once the table passes SPLIT_TABLE_ROWS rows — the
-    # regime where destination-block cost dominates count-bound cost).
+    # Table layout: the combined 1+dim table is the default — one
+    # lookup gather + one grad scatter per step where the reference's
+    # split linear+fm layout paid two (the dual-lookup waste the old
+    # comment documented).  `split_tables` stays as the COMPAT FLAG:
+    # checkpoints saved under the split layout restore only into a
+    # split build (ps_trainer's manifest check names this flag), so
+    # pass --model_params split_tables=true to keep reading them.
+    # None = auto: merged everywhere EXCEPT the one measured exception
+    # — strict per-step apply at >SPLIT_TABLE_ROWS rows under the XLA
+    # sparse path, where the per-step table-sized streaming pass
+    # charges by destination blocks (merged doubles them; 192k->157k,
+    # BASELINE.md table-scale probe).  The fused kernel path
+    # (--sparse_kernel=fused) is touched-row-bound with no streaming
+    # pass, so it keeps the merged layout at every scale.
     split_tables: bool | None = None
     sparse_apply_every: int = 1
+    # 'xla' | 'fused' | 'auto' | None (process default) — threaded into
+    # the Embedding layers (lookup/FM kernels) and the auto layout rule.
+    sparse_kernel: str | None = None
+
+    def _resolved_kernel(self) -> str:
+        from elasticdl_tpu.ops import sparse_embedding as ske
+
+        return ske.resolve_kernel(self.sparse_kernel)
 
     def _split(self, total_vocab: int) -> bool:
         if self.split_tables is not None:
@@ -55,6 +74,7 @@ class DeepFM(nn.Module):
         return (
             self.sparse_apply_every <= 1
             and total_vocab > SPLIT_TABLE_ROWS
+            and self._resolved_kernel() != "fused"
         )
 
     @nn.compact
@@ -67,41 +87,56 @@ class DeepFM(nn.Module):
         total_vocab = self.vocab_size * cats.shape[-1]
 
         first_dense = nn.Dense(1, name="linear_dense")(dense)[..., 0]
-        if self._split(total_vocab):
-            # TWO tables (the reference's layout: linear + fm).  Costs a
-            # second lookup gather + grad scatter (~25 ns/row each), but
-            # the dim-1 table packs 128 rows/block and the dim-8 table
-            # 16 rows/block — 1.83M destination blocks at the 26M probe
-            # vs the merged table's 3.25M, which is what strict mode's
-            # per-step table-sized passes charge for.
-            linear = Embedding(
-                total_vocab, 1, name="linear_embedding"
-            )(flat_ids)                                      # [B, 26, 1]
-            first_cat = jnp.sum(linear[..., 0], axis=-1)     # [B]
-            cat_emb = Embedding(
-                total_vocab, self.embedding_dim, name="fm_embedding"
-            )(flat_ids)                                      # [B, 26, d]
-        else:
-            # ONE merged table of dim 1+d: lane 0 is the first-order
-            # (linear) weight, lanes 1..d the FM/deep field vector —
-            # halves the count-bound sparse costs (one gather + one
-            # scatter per step instead of two), the right trade except
-            # under strict mode at >10M rows (see SPLIT_TABLE_ROWS).
-            merged = Embedding(
-                total_vocab, 1 + self.embedding_dim, name="fm_embedding"
-            )(flat_ids)                                      # [B, 26, 1+d]
-            first_cat = jnp.sum(merged[..., 0], axis=-1)     # [B]
-            cat_emb = merged[..., 1:]                        # [B, 26, d]
         dense_emb = nn.DenseGeneral(
             (NUM_DENSE, self.embedding_dim), axis=-1, name="dense_projection"
         )(dense[:, None, :])[:, 0]                           # [B, 13, d]
-        fields = jnp.concatenate([cat_emb, dense_emb], axis=1)  # [B, 39, d]
-
-        # FM second order: 0.5 * (sum^2 - sum-of-squares).
-        sum_fields = jnp.sum(fields, axis=1)
-        second = 0.5 * jnp.sum(
-            sum_fields * sum_fields - jnp.sum(fields * fields, axis=1), axis=-1
-        )
+        if self._split(total_vocab):
+            # TWO tables (the reference's layout: linear + fm) — the
+            # xla-strict->10M-row exception only (see split_tables):
+            # a second lookup gather + grad scatter (~25 ns/row each)
+            # buys halved destination blocks for the per-step streaming
+            # passes (1.83M vs 3.25M at the 26M probe).
+            linear = Embedding(
+                total_vocab, 1, name="linear_embedding",
+                sparse_kernel=self.sparse_kernel,
+            )(flat_ids)                                      # [B, 26, 1]
+            first_cat = jnp.sum(linear[..., 0], axis=-1)     # [B]
+            cat_emb = Embedding(
+                total_vocab, self.embedding_dim, name="fm_embedding",
+                sparse_kernel=self.sparse_kernel,
+            )(flat_ids)                                      # [B, 26, d]
+            # FM second order: 0.5 * (sum^2 - sum-of-squares) over all
+            # 39 fields at once.
+            fields = jnp.concatenate([cat_emb, dense_emb], axis=1)
+            sum_fields = jnp.sum(fields, axis=1)
+            second = 0.5 * jnp.sum(
+                sum_fields * sum_fields
+                - jnp.sum(fields * fields, axis=1),
+                axis=-1,
+            )
+        else:
+            # ONE merged table of dim 1+d (the default layout): lane 0
+            # is the first-order (linear) weight, lanes 1..d the
+            # FM/deep field vector — one gather + one scatter per step
+            # instead of two.  fm_interaction returns the activations
+            # (deep tower input) AND the categorical FM partial sums
+            # from the same pass — under the fused kernel those sums
+            # accumulate in VMEM during the lookup, so the FM term
+            # never re-reads [B, 26, 1+d] from HBM.  The dense fields'
+            # sums compose algebraically:
+            #   (S_cat + S_dense)^2 - (SS_cat + SS_dense)
+            cat_acts, first_cat, sum_v, sum_sq = Embedding(
+                total_vocab, 1 + self.embedding_dim, name="fm_embedding",
+                sparse_kernel=self.sparse_kernel, fm_interaction=True,
+            )(flat_ids)                                      # [B, 26, 1+d]
+            cat_emb = cat_acts[..., 1:]                      # [B, 26, d]
+            fields = jnp.concatenate([cat_emb, dense_emb], axis=1)
+            sum_dense = jnp.sum(dense_emb, axis=1)           # [B, d]
+            sumsq_dense = jnp.sum(dense_emb * dense_emb, axis=1)
+            total_sum = sum_v + sum_dense
+            second = 0.5 * jnp.sum(
+                total_sum * total_sum - (sum_sq + sumsq_dense), axis=-1
+            )
 
         # Deep tower over the flattened field embeddings.
         x = fields.reshape((batch, -1))
@@ -118,6 +153,7 @@ def custom_model(
     hidden: int = 128,
     split_tables: bool | None = None,
     sparse_apply_every: "int | str" = 1,
+    sparse_kernel: "str | None" = None,
 ):
     """`sparse_apply_every` arrives from the job flag (model_utils
     forwards it to models declaring the parameter) and drives the auto
@@ -149,6 +185,7 @@ def custom_model(
         hidden=hidden,
         split_tables=split_tables,
         sparse_apply_every=sparse_apply_every,
+        sparse_kernel=sparse_kernel,
     )
 
 

@@ -38,6 +38,9 @@ Usage:
     python scripts/convergence_ab.py --w 16 --bias global   # one config
     # round-5 seed replication (3 seeds x the 3 shipped configs):
     python scripts/convergence_ab.py --all --sweep-seeds 0,1,2 --out f.jsonl
+    # round-6 fused-kernel gates (ops/sparse_embedding.py):
+    python scripts/convergence_ab.py --smoke             # CPU, make test-sparse
+    python scripts/convergence_ab.py --all --sparse-kernel fused   # chip
 """
 
 from __future__ import annotations
@@ -101,9 +104,13 @@ def run_config(args) -> dict:
     mesh = build_mesh(MeshConfig())
     trainer = ShardedEmbeddingTrainer(
         # Same rule as bench.py: the model's per-mode table layout must
-        # see the SAME apply mode the trainer runs, or a headline-scale
-        # A/B would validate a layout the headline never uses.
-        zoo.custom_model(vocab_size=args.vocab, sparse_apply_every=args.w),
+        # see the SAME apply mode AND kernel the trainer runs, or a
+        # headline-scale A/B would validate a layout/engine the
+        # headline never uses.
+        zoo.custom_model(
+            vocab_size=args.vocab, sparse_apply_every=args.w,
+            sparse_kernel=args.sparse_kernel,
+        ),
         zoo.loss,
         zoo.optimizer(),
         mesh,
@@ -111,6 +118,7 @@ def run_config(args) -> dict:
             args.emb_lr, bias_correction=args.bias
         ),
         sparse_apply_every=args.w,
+        sparse_kernel=args.sparse_kernel,
         seed=args.seed,
     )
     mask = np.ones((args.batch,), np.float32)
@@ -163,6 +171,7 @@ def run_config(args) -> dict:
     result = {
         "w": args.w,
         "bias": args.bias,
+        "sparse_kernel": args.sparse_kernel,
         "seed": args.seed,
         "emb_lr": args.emb_lr,
         "vocab": args.vocab,
@@ -217,6 +226,7 @@ def run_all(args) -> None:
             "--eval-examples", str(args.eval_examples),
             "--window", str(args.window), "--zipf", str(args.zipf),
             "--emb-lr", str(args.emb_lr),
+            "--sparse-kernel", args.sparse_kernel,
         ]
         print(f"=== W={w} bias={bias} seed={seed} ===", flush=True)
         proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -266,9 +276,60 @@ def run_all(args) -> None:
             )
 
 
+def run_smoke(args) -> int:
+    """The `make test-sparse` convergence gate: a tiny CPU config of
+    the SAME controlled A/B, run for both sparse kernels in-process
+    (interpret-mode Pallas on CPU), asserting the fused engine trains
+    the model as well as the xla engine — losses finite, held-out AUC
+    within a coarse bound of each other and above chance.  Minutes of
+    CPU, no chip; the full-scale fused A/B
+    (`--all --sparse-kernel fused`) is queued chip work."""
+    import copy
+
+    results = {}
+    for kernel in ("xla", "fused"):
+        cfg = copy.copy(args)
+        cfg.sparse_kernel = kernel
+        cfg.w = 1
+        cfg.bias = "per_row"
+        cfg.vocab = 500
+        cfg.batch = 256
+        cfg.steps_per_epoch = 24
+        cfg.epochs = 2
+        cfg.eval_examples = 2048
+        cfg.window = 8
+        results[kernel] = run_config(cfg)
+        print(json.dumps(results[kernel]), flush=True)
+    auc_x = results["xla"]["peak_auc"]
+    auc_f = results["fused"]["peak_auc"]
+    assert auc_x > 0.55 and auc_f > 0.55, (
+        f"smoke configs failed to learn: xla {auc_x} fused {auc_f}"
+    )
+    assert abs(auc_x - auc_f) < 0.02, (
+        f"fused kernel trains differently from xla: "
+        f"peak AUC {auc_f} vs {auc_x}"
+    )
+    print(
+        f"convergence smoke OK: peak AUC xla {auc_x:.4f} vs fused "
+        f"{auc_f:.4f} (|delta| < 0.02)", flush=True,
+    )
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--all", action="store_true")
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="tiny CPU fused-vs-xla convergence gate (make test-sparse)",
+    )
+    p.add_argument(
+        "--sparse-kernel", choices=["xla", "fused"], default="xla",
+        dest="sparse_kernel",
+        help="sparse-path engine under test (ops/sparse_embedding.py); "
+        "the fused A/B at headline scale is the chip-side gate for "
+        "--sparse_kernel=fused",
+    )
     p.add_argument(
         "--sweep-seeds", default="",
         help="comma-separated seed list; with --all, runs SEED_CONFIGS "
@@ -293,6 +354,8 @@ def main():
     p.add_argument("--emb-lr", type=float, default=0.001)
     p.add_argument("--out", default="")
     args = p.parse_args()
+    if args.smoke:
+        sys.exit(run_smoke(args))
     if args.all:
         run_all(args)
     else:

@@ -1,33 +1,40 @@
 """Perf experiment: can ANY engine beat the XLA row gather that bounds
-the sparse embedding path?  (VERDICT round-3 #7, time-boxed.)
+the sparse embedding path?  (VERDICT round-3 #7, extended round 6 into
+the xla-vs-fused kernel microbench.)
 
 The 26M-row probe spends ~5.5 ms/step in lookup-gather + row ops and
 ~2.7 ms in the grad scatter — count-bound at ~25 ns per touched row
-(BASELINE.md).  The only hypothesized path below that floor was a fused
-Pallas lookup/scatter engine.  This harness measures, on the real chip:
+(BASELINE.md).  Round 3 measured the incumbents plus a Pallas
+scalar-prefetch gather; round 6 adds the shipped fused kernel family
+(ops/sparse_embedding.py) so each stage of the sparse path has an
+xla-vs-fused ns/row number:
 
-  1. the raw XLA storage-row gather (pk.lookup minus the slot-select
-     einsum) — the incumbent;
-  2. full pk.lookup (gather + one-hot slot select) — what the model pays;
-  3. a Pallas scalar-prefetch gather: grid over ids, each step DMAs one
-     512 B storage row HBM->VMEM->HBM with the id stream scalar-prefetched
-     so the pipeline emitter double-buffers the row fetches.  This is the
-     idiomatic TPU formulation of a "coalesced DMA" gather (the round-3
-     experiment issued EXPLICIT per-row async copies instead and measured
-     a 0.3 us/row issue-bound floor);
-  4. the packed grad scatter-add (pk.scatter_add) — the write side.
+  lookup:   raw storage-row gather / pk.lookup (gather + one-hot
+            select) / fused_lookup (gather-and-lane-select kernel);
+  dedup:    packed.dedup_representatives alone (the sort-free
+            segment-combine both scatter and fused modes share);
+  apply:    the full sparse-adam update — dedup + scatter_apply's
+            gather/update/scatter trips (xla) vs fused_dedup_apply's
+            one-kernel pass;
+  scatter:  pk.scatter_add (the raw write side, context).
 
 Compare against the arithmetic floors: 213k rows x 512 B = 109 MB moved
 twice (read + write) = ~0.27 ms at 819 GB/s IF the access were
 sequential — the gap between that and the measured rate is random-access
 row granularity, which no kernel formulation removes.
 
+`--selftest` runs a tiny CPU configuration through every engine in
+Pallas interpret mode and asserts the fused results against the xla
+references — the `make test-sparse` gate that keeps this harness (and
+the kernels it measures) runnable without a chip.
+
 Usage: python scripts/exp_sparse_gather.py [n_ids] [vocab_rows]
+       python scripts/exp_sparse_gather.py --selftest
 """
 
 from __future__ import annotations
 
-import functools
+import argparse
 import os
 import sys
 import time
@@ -56,17 +63,35 @@ def _time(fn, *args) -> float:
     return sorted(times)[2] / INNER
 
 
-def main():
+def _loop(body):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(*args):
+        def step(i, tot):
+            return tot + body(i, *args)
+
+        return jax.lax.fori_loop(0, INNER, step, jnp.float32(0))
+
+    return fn
+
+
+def _row(label: str, t: float, n_ids: int):
+    print(f"{label:<20} {t * 1e3:7.3f} ms  {t / n_ids * 1e9:6.1f} ns/row",
+          flush=True)
+
+
+def main(n_ids: int, vocab: int):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from elasticdl_tpu.ops import sparse_embedding as ske
     from elasticdl_tpu.parallel import packed as pk
+    from elasticdl_tpu.parallel import sparse_optim
     from elasticdl_tpu.parallel.packed import PackedSpec
 
-    n_ids = int(sys.argv[1]) if len(sys.argv) > 1 else 212_992
-    vocab = int(sys.argv[2]) if len(sys.argv) > 2 else 26_000_000
     spec = PackedSpec(vocab, 16)  # dim 16: one row per 128-lane block
     rng = np.random.RandomState(0)
     # Generate directly in packed shape (a logical->packed relayout at
@@ -83,39 +108,37 @@ def main():
         f"{n_ids} ids", flush=True,
     )
 
-    def loop(body):
-        def fn(*args):
-            def step(i, tot):
-                return tot + body(i, *args)
-
-            return jax.lax.fori_loop(0, INNER, step, jnp.float32(0))
-
-        return fn
+    # -- lookup engines --------------------------------------------------
 
     # 1. raw storage-row gather (what jnp.take lowers to).
     t = _time(
-        loop(lambda i, tb, ix: jnp.sum(jnp.take(tb, ix + i, axis=0))),
+        _loop(lambda i, tb, ix: jnp.sum(jnp.take(tb, ix + i, axis=0))),
         table, ids // spec.rows_per_block,
     )
-    print(f"raw row gather:      {t * 1e3:7.3f} ms  "
-          f"{t / n_ids * 1e9:6.1f} ns/row", flush=True)
+    _row("raw row gather:", t, n_ids)
 
-    # 2. full packed lookup (gather + slot-select einsum).
+    # 2. full packed lookup (gather + slot-select einsum) — what the
+    # xla model path pays.
     t = _time(
-        loop(lambda i, tb, ix: jnp.sum(pk.lookup(spec, tb, ix + i))),
+        _loop(lambda i, tb, ix: jnp.sum(pk.lookup(spec, tb, ix + i))),
         table, ids,
     )
-    print(f"pk.lookup:           {t * 1e3:7.3f} ms  "
-          f"{t / n_ids * 1e9:6.1f} ns/row", flush=True)
+    _row("pk.lookup (xla):", t, n_ids)
 
-    # 3. Pallas scalar-prefetch gather: one DMA per grid step, the id
-    # stream scalar-prefetched so the pipeline emitter double-buffers
-    # the fetches.  Pallas TPU requires (8, 128)-aligned blocks, so each
-    # step fetches the aligned 8-row block CONTAINING the target row —
-    # 8x the useful bytes, but the per-step rate measures exactly what a
-    # one-row-per-step engine could ever achieve (a (1, 128) block is
-    # not lowerable; the per-useful-row cost of this engine is the
-    # per-step cost).
+    # 3. fused gather-and-lane-select kernel (the shipped engine).
+    t = _time(
+        _loop(
+            lambda i, tb, ix: jnp.sum(ske.fused_lookup(spec, tb, ix + i))
+        ),
+        table, ids,
+    )
+    _row("fused_lookup:", t, n_ids)
+
+    # 4. the round-3 Pallas scalar-prefetch one-row-per-step gather,
+    # kept as the historical formulation floor probe: each step fetches
+    # the aligned 8-row block CONTAINING the target row — 8x the useful
+    # bytes, but the per-step rate measures what a one-row-per-grid-step
+    # engine could ever achieve.
     def gather_kernel(ids_ref, rows_ref, out_ref):
         out_ref[...] = rows_ref[...].reshape(out_ref.shape)
 
@@ -143,31 +166,109 @@ def main():
 
     try:
         t = _time(
-            loop(lambda i, tb, ix: jnp.sum(pallas_gather(tb, ix + i))),
+            _loop(lambda i, tb, ix: jnp.sum(pallas_gather(tb, ix + i))),
             table, ids // spec.rows_per_block // 8,
         )
-        print(f"pallas sp gather:    {t * 1e3:7.3f} ms  "
-              f"{t / n_ids * 1e9:6.1f} ns/row", flush=True)
+        _row("pallas sp gather:", t, n_ids)
     except Exception as e:  # noqa: BLE001 — record the failure mode
         print(f"pallas sp gather:    FAILED ({type(e).__name__}: "
               f"{str(e)[:200]})", flush=True)
 
-    # 4. grad scatter-add (the write side of the sparse path).
+    # -- dedup + apply engines -------------------------------------------
+
+    # 5. the sort-free segment-combine alone (shared by scatter + fused).
     t = _time(
-        loop(
+        _loop(
+            lambda i, ix, g: jnp.sum(
+                pk.dedup_representatives(spec, ix + i, g)[1]
+            )
+        ),
+        ids, grads,
+    )
+    _row("dedup (both):", t, n_ids)
+
+    # 6/7. full sparse-adam apply: xla scatter path vs fused kernel.
+    opt_x = sparse_optim.adam(0.001, mode="scatter",
+                              bias_correction="global")
+    opt_f = sparse_optim.adam(0.001, mode="fused",
+                              bias_correction="global")
+    slots = opt_x.init_slots(spec, table)
+
+    def apply_body(opt):
+        def body(i, tb, sl, ix, g):
+            new_tb, new_sl = opt.apply(spec, tb, sl, ix + i, g)
+            return jnp.sum(new_tb[0])
+
+        return body
+
+    t = _time(_loop(apply_body(opt_x)), table, slots, ids, grads)
+    _row("adam apply (xla):", t, n_ids)
+    t = _time(_loop(apply_body(opt_f)), table, slots, ids, grads)
+    _row("adam apply (fused):", t, n_ids)
+
+    # 8. grad scatter-add (the raw write side, context).
+    t = _time(
+        _loop(
             lambda i, tb, ix, g: jnp.sum(
                 pk.scatter_add(spec, tb, ix + i, g)[0]
             )
         ),
         table, ids, grads,
     )
-    print(f"pk.scatter_add:      {t * 1e3:7.3f} ms  "
-          f"{t / n_ids * 1e9:6.1f} ns/row", flush=True)
+    _row("pk.scatter_add:", t, n_ids)
 
     bw_floor_ms = 2 * n_ids * spec.block_width * 4 / 819e9 * 1e3
     print(f"sequential-BW floor: {bw_floor_ms:7.3f} ms  "
           f"{bw_floor_ms / n_ids * 1e6:6.1f} ns/row", flush=True)
 
 
+def selftest() -> int:
+    """CPU interpret-mode gate: every engine this harness measures runs
+    and the fused results match the xla references (bit-exact for the
+    lookup — pure data movement — and within the documented 1-ulp FMA
+    tolerance for the adam apply)."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops import sparse_embedding as ske
+    from elasticdl_tpu.parallel import packed as pk
+    from elasticdl_tpu.parallel import sparse_optim
+    from elasticdl_tpu.parallel.packed import PackedSpec
+
+    rng = np.random.RandomState(0)
+    spec = PackedSpec(300, 16)
+    table = jnp.asarray(rng.rand(*spec.packed_shape).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, 300, size=64).astype(np.int32))
+    grads = jnp.asarray(rng.rand(64, spec.dim).astype(np.float32))
+
+    ref = np.asarray(pk.lookup(spec, table, ids))
+    got = np.asarray(ske.fused_lookup(spec, table, ids))
+    assert np.array_equal(ref, got), "fused_lookup != pk.lookup"
+
+    opt_x = sparse_optim.adam(0.001, mode="scatter")
+    opt_f = sparse_optim.adam(0.001, mode="fused")
+    slots = opt_x.init_slots(spec, table)
+    tx, sx = opt_x.apply(spec, table, slots, ids, grads)
+    tf, sf = opt_f.apply(spec, table, slots, ids, grads)
+    np.testing.assert_allclose(
+        np.asarray(tf), np.asarray(tx), rtol=3e-7, atol=1e-7,
+        err_msg="fused adam table",
+    )
+    for key in sx:
+        np.testing.assert_allclose(
+            np.asarray(sf[key]), np.asarray(sx[key]), rtol=3e-7, atol=1e-7,
+            err_msg=f"fused adam slot {key}",
+        )
+    print("exp_sparse_gather selftest OK "
+          "(fused lookup + adam apply match xla, interpret mode)")
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("n_ids", nargs="?", type=int, default=212_992)
+    parser.add_argument("vocab", nargs="?", type=int, default=26_000_000)
+    parser.add_argument("--selftest", action="store_true")
+    args = parser.parse_args()
+    if args.selftest:
+        sys.exit(selftest())
+    main(args.n_ids, args.vocab)

@@ -64,6 +64,11 @@ EVENT_REQUIRED_FIELDS = {
     # Bench regression gate (scripts/bench_regress.py): per-metric
     # verdicts of a bench.py run vs the recorded baseline spread.
     "bench_regress": ("verdict", "metrics_total", "regressed"),
+    # Sparse-path engine decision (parallel/ps_trainer.py init): which
+    # lookup/apply engine (xla vs the fused Pallas kernels) a training
+    # run's numbers were measured on — postmortems and bench audits
+    # must not have to guess (docs/design.md "Fused sparse kernels").
+    "sparse_kernel_selected": ("kernel",),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -224,6 +229,9 @@ def _selftest() -> int:
         {"ts": 6.95, "event": "bench_regress", "verdict": "regressed",
          "metrics_total": 8, "regressed": 1,
          "details": [{"metric": "deepfm", "ratio": 0.8}]},
+        {"ts": 6.97, "event": "sparse_kernel_selected", "kernel": "fused",
+         "requested": "fused", "optimizer": "adam", "tables": 1,
+         "table_rows": 26000000},
         {"ts": 7.0, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
@@ -232,6 +240,7 @@ def _selftest() -> int:
         '{"ts": 1.3, "event": "step_anatomy", "totals": {}}',  # no worker_id
         '{"ts": 1.35, "event": "profile_window", "worker_id": 1}',  # no action
         '{"ts": 1.4, "event": "bench_regress", "verdict": "ok"}',  # no counts
+        '{"ts": 1.45, "event": "sparse_kernel_selected"}',  # no kernel
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

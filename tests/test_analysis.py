@@ -492,6 +492,85 @@ def test_host_sync_sees_scan_body_and_lambda_roots():
     assert len(found) == 1
 
 
+def test_pallas_kernel_bodies_are_traced():
+    """Satellite of the fused-sparse-kernel PR: a pl.pallas_call kernel
+    body IS traced code — host syncs and obs/lock calls inside it are
+    flagged, including when the kernel arrives through
+    functools.partial (the flash_attention / sparse_embedding idiom)."""
+    found = violations(
+        """
+        import functools
+        import numpy as np
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref, *, scale):
+            v = x_ref[...]
+            np.asarray(v)
+            o_ref[...] = v * scale
+
+        def launch(x):
+            return pl.pallas_call(
+                functools.partial(kernel, scale=2.0),
+                out_shape=None,
+            )(x)
+        """,
+        "jit-host-sync",
+    )
+    assert len(found) == 1 and "kernel" in found[0].message
+    found = violations(
+        """
+        from jax.experimental import pallas as pl
+        from elasticdl_tpu import obs
+
+        def kernel(x_ref, o_ref):
+            obs.journal().record("step", n=1)
+            o_ref[...] = x_ref[...]
+
+        def launch(x):
+            return pl.pallas_call(kernel, out_shape=None)(x)
+        """,
+        "trace-purity",
+    )
+    assert len(found) == 1 and "obs-plane" in found[0].message
+
+
+def test_pallas_index_map_lambdas_stay_host_scope():
+    """Index-map lambdas inside BlockSpec/GridSpec run at trace SETUP
+    on the host — shape math, numpy, and mutable captures there are
+    legal and must not false-positive, even when the grid spec rides a
+    POSITIONAL pallas_call argument (the PrefetchScalarGridSpec
+    idiom)."""
+    fixture = """
+        import numpy as np
+        import jax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(ids_ref, x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def launch(x, offsets):
+            starts = [int(np.asarray(o)) for o in offsets]
+            return pl.pallas_call(
+                kernel,
+                pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(4,),
+                    in_specs=[
+                        pl.BlockSpec(
+                            (8, 128),
+                            lambda i, p: (starts[0] + np.int32(0), 0),
+                        ),
+                    ],
+                    out_specs=pl.BlockSpec((8, 128), lambda i, p: (i, 0)),
+                ),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """
+    assert violations(fixture, "retrace-hazard") == []
+    assert violations(fixture, "jit-host-sync") == []
+
+
 def test_retrace_hazard_flags_jit_in_loop_and_per_step_method():
     found = violations(
         """
