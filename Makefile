@@ -4,8 +4,8 @@
 # targets are the explicit developer entry points.
 
 .PHONY: all proto native test test-fast test-sparse sparse-gates \
-        test-chaos test-obs e2e bench bench-regress wheel clean lint \
-        check-invariants
+        test-compile compile-gates test-chaos test-obs e2e bench \
+        bench-regress wheel clean lint check-invariants
 
 all: proto native test
 
@@ -51,10 +51,12 @@ lint:
 # The elastic policy-engine units (tests/test_policy.py: eviction
 # hysteresis + kill budget, amortization math, thrash scale-down, the
 # pod-manager scale-down regression) ride in tests/ here.
-# sparse-gates (not the pytest files) chain into test-fast: the kernel
-# test files already ride test-fast's own `pytest tests/` sweep, so
-# chaining full test-sparse would run them twice per tier-1 pass.
-test-fast: lint sparse-gates
+# sparse-gates / compile-gates (not the pytest files) chain into
+# test-fast: the kernel and compile-layer test files already ride
+# test-fast's own `pytest tests/` sweep, so chaining the full
+# test-sparse / test-compile targets would run them twice per tier-1
+# pass.
+test-fast: lint sparse-gates compile-gates
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
 # Script gates of the sparse path, shared by test-sparse and test-fast:
@@ -64,6 +66,25 @@ test-fast: lint sparse-gates
 sparse-gates:
 	JAX_PLATFORMS=cpu python scripts/exp_sparse_gather.py --selftest
 	JAX_PLATFORMS=cpu python scripts/convergence_ab.py --smoke
+
+# Script gate of the declarative compile layer's shard_map kernel
+# dispatch, shared by test-compile and test-fast: the multi-device
+# microbench's interpret-mode selftest on a forced 4-virtual-device
+# mesh (sharded fused lookup bit-exact, sharded fused apply within the
+# documented 1-ulp tolerance).
+compile-gates:
+	JAX_PLATFORMS=cpu python scripts/exp_sparse_gather.py --shard_map --selftest
+
+# Standalone declarative-sharding gate (docs/design.md "Declarative
+# sharding"): rule-table semantics over the zoo pytrees,
+# pjit-vs-shard_map strategy selection + donation round-trip,
+# per-trainer HLO-structure parity vs the pre-port hand-rolled steps,
+# the no-direct-jit grep gate, the shard_map microbench selftest, and
+# the multi-device fused-vs-xla equivalence + per-shard HLO tests.
+test-compile: compile-gates
+	JAX_PLATFORMS=cpu python -m pytest tests/test_compile.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu python -m pytest tests/test_sparse_kernels.py \
+	       -q -m 'not slow' -k 'multi_device or multichip or dispatch_route'
 
 # Standalone sparse-path gate (docs/design.md "Fused sparse kernels"):
 # the fused Pallas kernel family vs the XLA reference paths in

@@ -28,6 +28,11 @@ real TPU chip), ten metrics:
   headline config on the fused Pallas sparse kernels
   (`--sparse_kernel=fused`, ops/sparse_embedding.py) — tracked:false
   until the first driver measurement (BASELINE.md queued chip work).
+- `deepfm_train_fused_multichip_samples_per_sec_per_chip` (round 7):
+  the same fused config dispatched through shard_map over EVERY
+  visible device (tables block-sharded over `model`) — per-chip rate,
+  tracked:false until multi-chip driver evidence; the scale-out
+  survival row of the fused win.
 - `deepfm_train_samples_per_sec_per_chip` (config 4, printed LAST — the
   flagship headline, strict per-step golden contract): full
   ParameterServerStrategy step — packed sharded embedding lookup, FM +
@@ -104,6 +109,12 @@ SELF_BASELINE = {
     # vs_baseline reads directly as the fused-vs-incumbent speedup; the
     # row stays tracked:false until a driver bench verifies it.
     "deepfm_train_fused_samples_per_sec_per_chip": 972_913.0,
+    # Fused kernels dispatched through shard_map over every visible
+    # device (round 7, tables block-sharded over `model`): per-chip
+    # throughput against the same provisional xla-strict anchor, so
+    # vs_baseline ~1.0 means the fused win SURVIVES scale-out.
+    # tracked:false until a multi-chip driver run records evidence.
+    "deepfm_train_fused_multichip_samples_per_sec_per_chip": 972_913.0,
     # The production data plane, file -> device-ready batches, one host
     # core (first measured round 3; the coupled e2e number is tracked
     # with a wide documented spread — tunnel-transfer-bound, BASELINE.md
@@ -166,6 +177,7 @@ def bench_deepfm(
     embedding_optimizer=None,
     sparse_apply_every: int = 1,
     sparse_kernel=None,
+    mesh_config=None,
 ):
     import jax
 
@@ -173,15 +185,16 @@ def bench_deepfm(
     from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
     from model_zoo.deepfm import deepfm_functional_api as zoo
 
-    mesh = build_mesh(MeshConfig())
+    mesh = build_mesh(mesh_config or MeshConfig())
     trainer = ShardedEmbeddingTrainer(
         # The model's per-mode table layout must see the SAME apply mode
         # AND kernel the trainer runs (merged table under windowed apply
         # or the fused kernels, split under strict-xla at >10M rows —
-        # model_zoo/deepfm SPLIT_TABLE_ROWS).
+        # model_zoo/deepfm SPLIT_TABLE_ROWS), and the mesh routes the
+        # fused kernels' dispatch (shard_map on multi-device).
         zoo.custom_model(
             vocab_size=vocab, sparse_apply_every=sparse_apply_every,
-            sparse_kernel=sparse_kernel,
+            sparse_kernel=sparse_kernel, mesh=mesh,
         ),
         zoo.loss,
         zoo.optimizer(),
@@ -245,6 +258,26 @@ def bench_deepfm_fused():
     queued chip work); the provisional baseline is the xla-strict
     round-4 measurement, so vs_baseline > 1.0 IS the fused speedup."""
     return bench_deepfm(sparse_kernel="fused")
+
+
+def bench_deepfm_fused_multichip():
+    """The fused headline config with the kernels dispatched through
+    shard_map over EVERY visible device (round 7: the multi-chip fused
+    path — tables block-shard over the mesh's `model` axis, ids route
+    to their owning shard, combine is a psum;
+    ops/sparse_embedding.py "Sharded dispatch").  On a single-device
+    host this degenerates to the single-chip fused number (the
+    `devices` field says which was measured); the row stays
+    tracked:false until a real multi-chip driver run records the
+    per-chip evidence (BASELINE.md queued chip work)."""
+    import jax
+
+    from elasticdl_tpu.parallel import MeshConfig
+
+    n = max(1, len(jax.devices()))
+    return bench_deepfm(
+        sparse_kernel="fused", mesh_config=MeshConfig(data=1, model=n)
+    )
 
 
 def bench_deepfm_table_scale():
@@ -748,6 +781,7 @@ def _roofline_fields(metric: str, value: float) -> dict:
     if metric in (
         "deepfm_train_samples_per_sec_per_chip",
         "deepfm_train_fused_samples_per_sec_per_chip",
+        "deepfm_train_fused_multichip_samples_per_sec_per_chip",
         "deepfm_26m_table_samples_per_sec_per_chip",
         "deepfm_e2e_samples_per_sec_per_chip",
     ):
@@ -989,6 +1023,20 @@ def main():
             "fused kernels not yet chip-verified (BASELINE.md round-6 "
             "queued chip work); flips tracked with the first driver "
             "measurement"
+        ),
+    )
+    fmc_samples_per_sec, fmc_spread = bench_deepfm_fused_multichip()
+    _emit(
+        "deepfm_train_fused_multichip_samples_per_sec_per_chip",
+        fmc_samples_per_sec,
+        "samples/sec/chip",
+        fmc_spread,
+        tracked=False,
+        devices=_device_count(),
+        untracked_reason=(
+            "shard_map'd fused dispatch awaits multi-chip driver "
+            "evidence (BASELINE.md queued chip work); on 1 device this "
+            "degenerates to the single-chip fused number"
         ),
     )
     # The north-star headline prints LAST (the driver parses the final

@@ -54,6 +54,15 @@ TRACE_ENTRY_NAMES = frozenset(
         "jit",
         "pjit",
         "shard_map",
+        # parallel/compile.py entry points: `shard_map_call(fn, ...)`
+        # and `CompilePlan.compile(fn, ...)` trace their function
+        # argument exactly like the jax primitives they wrap — ported
+        # trainers build every step through them, and the hot-path
+        # rules must keep seeing those bodies as traced.  ("compile"
+        # exact-matches the last segment; `re.compile("...")` is
+        # harmless — a string argument marks nothing.)
+        "shard_map_call",
+        "compile",
         "pallas_call",
         "scan",
         "associative_scan",
@@ -75,7 +84,10 @@ TRACE_ENTRY_NAMES = frozenset(
 
 #: Entry names that are jit *compilation* sites specifically (the rules
 #: about donation / sharding / retracing only apply to these).
-JIT_ENTRY_NAMES = frozenset({"jit", "pjit"})
+#: `compile` = CompilePlan.compile, the declarative layer's jit-building
+#: entry (parallel/compile.py) — its sites carry the same
+#: donation/sharding kwargs jax.jit does.
+JIT_ENTRY_NAMES = frozenset({"jit", "pjit", "compile"})
 
 #: Decorator name segments that make the decorated function a trace root.
 TRACED_DECORATOR_NAMES = frozenset(
@@ -175,6 +187,21 @@ def _last_segment(node: ast.AST) -> Optional[str]:
     else:
         return None
     return name.lstrip("_") or name
+
+
+def _is_compile_plan_call(call: ast.Call) -> bool:
+    """Only `CompilePlan.compile(fn, ...)` — a method call taking a
+    FUNCTION-REFERENCE first argument — is the compile-layer entry.
+    `re.compile(...)` (any argument shape: literal, f-string,
+    concatenation, variable) and `lowered.compile()` are not."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        receiver = func.value
+        if isinstance(receiver, ast.Name) and receiver.id == "re":
+            return False
+    if not call.args:
+        return False
+    return isinstance(call.args[0], (ast.Name, ast.Attribute, ast.Lambda))
 
 
 def _entry_name_of(segment: Optional[str]) -> Optional[str]:
@@ -598,6 +625,8 @@ class TracedIndex:
 
     def _entry_of(self, call: ast.Call, ctx: _Ctx) -> Optional[str]:
         entry = _entry_name_of(_last_segment(call.func))
+        if entry == "compile" and not _is_compile_plan_call(call):
+            entry = None
         if entry:
             return entry
         if isinstance(call.func, ast.Name):

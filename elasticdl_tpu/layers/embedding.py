@@ -40,7 +40,7 @@ layer).
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -126,6 +126,12 @@ class Embedding(nn.Module):
     (the Pallas gather-and-lane-select kernel,
     ops/sparse_embedding.fused_lookup — bit-exact for in-vocab ids), or
     'auto'; None consults the process default set from --sparse_kernel.
+    mesh: the fused kernels' dispatch mesh — on a multi-device mesh the
+    fused lookup/FM ops route through shard_map (table blocks over the
+    `model` axis, psum combine; ops/sparse_embedding.py "Sharded
+    dispatch").  None consults the process default worker/main registers
+    (ske.set_dispatch_mesh); irrelevant under the xla kernel, whose ops
+    the SPMD partitioner shards on its own.
     fm_interaction: combined-table FM mode (DeepFM): ids must be
     [batch, fields] and __call__ returns ``(acts [batch, fields, dim],
     first [batch], sum_v [batch, dim-1], sum_sq [batch, dim-1])`` where
@@ -142,6 +148,7 @@ class Embedding(nn.Module):
     embeddings_initializer: Callable = default_embedding_init
     sparse_kernel: Optional[str] = None
     fm_interaction: bool = False
+    mesh: Optional[Any] = None
 
     @property
     def spec(self) -> PackedSpec:
@@ -203,6 +210,11 @@ class Embedding(nn.Module):
         from elasticdl_tpu.ops import sparse_embedding as ske
 
         kernel = ske.resolve_kernel(self.sparse_kernel)
+        # Fused dispatch mesh: explicit field first, then the process
+        # default worker/main registered.  Resolved at trace time (the
+        # mesh is a static host object), so one layer definition serves
+        # single-device and shard_map'd multi-device jobs alike.
+        mesh = self.mesh if self.mesh is not None else ske.dispatch_mesh()
         if self.fm_interaction:
             if self.combiner is not None:
                 raise ValueError("fm_interaction excludes a combiner")
@@ -221,7 +233,9 @@ class Embedding(nn.Module):
             )
             self.sow(IDS_COLLECTION, "ids", safe_ids)
             if kernel == "fused":
-                return ske.fused_lookup_fm(spec, table, bet, safe_ids, valid)
+                return ske.fused_lookup_fm(
+                    spec, table, bet, safe_ids, valid, mesh=mesh
+                )
             acts = pk.lookup(spec, table, safe_ids.reshape((-1,))).reshape(
                 safe_ids.shape + (self.embedding_dim,)
             )
@@ -237,7 +251,7 @@ class Embedding(nn.Module):
         # kernel's custom VJP carries the same sparse segment-sum
         # cotangent).
         lookup = (
-            functools.partial(ske.fused_lookup, spec, table)
+            functools.partial(ske.fused_lookup, spec, table, mesh=mesh)
             if kernel == "fused"
             else functools.partial(pk.lookup, spec, table)
         )

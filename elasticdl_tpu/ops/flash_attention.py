@@ -446,11 +446,22 @@ def flash_ring_step_carry(q, k_blk, v_blk, acc, lse, q_pos, k_pos, *,
     return acc_new, lse_new
 
 
+def _vma_of(x):
+    """`x`'s varying-mesh-axes type, or None on jax versions without
+    `jax.typeof` (pre-typed-vma releases: there is no vma type system
+    to satisfy, and the ring runs shard_map with the check disabled via
+    the check_rep fallback — see parallel/compile.shard_map_call)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    return getattr(typeof(x), "vma", None)
+
+
 def _out_struct(shape, dtype, like):
     """ShapeDtypeStruct that inherits `like`'s varying-mesh-axes type —
     required when these kernels run inside shard_map (the ring), where
     check_vma demands explicit output vma."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    vma = _vma_of(like)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -460,10 +471,10 @@ def _match_vma(x, like):
     """Give `x` at least `like`'s varying-mesh-axes type (shard_map's
     check_vma requires all kernel operands to agree; position arrays are
     only `model`-varying while q varies over the data axis too)."""
-    want = getattr(jax.typeof(like), "vma", None)
+    want = _vma_of(like)
     if not want:
         return x
-    have = getattr(jax.typeof(x), "vma", None) or frozenset()
+    have = _vma_of(x) or frozenset()
     missing = tuple(set(want) - set(have))
     return jax.lax.pvary(x, missing) if missing else x
 

@@ -58,13 +58,23 @@ Every kernel runs in Pallas interpret mode off-TPU (same
 exercise the real kernel bodies, and ``scripts/convergence_ab.py
 --sparse-kernel fused`` gates end-to-end training quality.
 
-Sharding caveat (v1): ``pl.pallas_call`` is not SPMD-partitionable the
-way the XLA gather/scatter ops are, so the fused mode targets tables
-resident on ONE device (the single-chip headline config).  On a
-multi-device mesh worker/main downgrades the whole job to xla before
-the model is built; a direct multi-device trainer construction with
-sparse_kernel='fused' is a config error (docs/design.md "Fused sparse
-kernels").  A shard_map-aware dispatch is the follow-up.
+Sharded dispatch (round 7): ``pl.pallas_call`` is not
+SPMD-partitionable the way the XLA gather/scatter ops are, so on a
+multi-device mesh every fused kernel routes through ``shard_map``
+(built via the parallel/compile.py shim) instead of the SPMD
+partitioner: embedding tables shard their storage blocks over the
+mesh's ``model`` axis (``table_partition_axis``), each shard runs the
+SAME kernel body over its resident blocks with ids routed to their
+owning shard (out-of-shard ids contribute exact zeros / are dropped by
+the dedup prologue), and the cross-shard combine is a ``psum`` for
+lookups and nothing at all for the apply (each shard owns its rows'
+writes; the batch gradient all-gathers over ``data`` first so every
+replica applies the identical update).  ``dispatch_route(mesh)``
+selects ``single_device`` (plain pallas_call) vs ``shard_map``;
+trainers journal the decision in ``sparse_kernel_selected``.  Tables
+whose blocks don't divide the model axis replicate (each shard then
+runs the full-table body — still inside shard_map, because manual
+sharding is what makes a pallas body legal on a multi-device mesh).
 """
 
 from __future__ import annotations
@@ -131,6 +141,77 @@ def resolve_kernel(requested: Optional[str] = None) -> str:
     if kernel == "auto":
         return "fused" if AUTO_FUSED_READY else "xla"
     return kernel
+
+
+# ----------------------------------------------------------------------
+# sharded dispatch (multi-device meshes; see the module docstring)
+# ----------------------------------------------------------------------
+
+#: Process-default dispatch mesh: worker/main registers the job's mesh
+#: so Embedding layers that did not thread `mesh` explicitly still take
+#: the shard_map route on multi-device worlds (an unpartitionable
+#: pallas_call traced into an SPMD program is the failure mode this
+#: replaces).  Ops-level functions consult ONLY their explicit `mesh`
+#: argument; the layer resolves None against this default.
+_DISPATCH_MESH = None
+
+
+def set_dispatch_mesh(mesh) -> None:
+    global _DISPATCH_MESH
+    _DISPATCH_MESH = mesh
+
+
+def dispatch_mesh():
+    return _DISPATCH_MESH
+
+
+def dispatch_route(mesh=None) -> str:
+    """'single_device' (plain pallas_call) or 'shard_map' (per-shard
+    kernel bodies inside shard_map) for a given mesh."""
+    if mesh is not None and int(mesh.devices.size) > 1:
+        return "shard_map"
+    return "single_device"
+
+
+def table_partition_axis(num_blocks: int, mesh) -> Optional[str]:
+    """Mesh axis the fused engine shards a table's storage blocks over:
+    the `model` axis when it divides them (the one table-placement
+    decision — ps_trainer's rule table and the shard_map in_specs here
+    both read it), else None (replicate — the table is tiny)."""
+    from elasticdl_tpu.parallel.mesh import MODEL_AXIS
+
+    if mesh is None:
+        return None
+    # Host ints throughout (mesh shape and PackedSpec fields are static
+    # Python values — no tracer ever reaches this decision).
+    msize = mesh.shape.get(MODEL_AXIS, 1)
+    if msize > 1 and num_blocks % msize == 0:
+        return MODEL_AXIS
+    return None
+
+
+def _shard_local_spec(spec: PackedSpec, mesh) -> PackedSpec:
+    """The per-shard PackedSpec under model-axis block sharding: same
+    dim/packing, 1/msize of the storage blocks (exact because
+    table_partition_axis demanded divisibility)."""
+    from elasticdl_tpu.parallel.mesh import MODEL_AXIS
+
+    msize = int(mesh.shape[MODEL_AXIS])
+    return PackedSpec(spec.vocab_padded // msize, spec.dim)
+
+
+def _batch_spec(n: int, mesh):
+    """PartitionSpec for a batch-derived dim0 of static size `n`: shard
+    over `data` when it divides (the trainers' padded batches always
+    do), else replicate — either split is CORRECT (routing/combine
+    never depend on which ids land on which data shard), sharding just
+    avoids redundant per-device work."""
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel.mesh import DATA_AXIS
+
+    dp = int(mesh.shape.get(DATA_AXIS, 1))
+    return P(DATA_AXIS) if n % dp == 0 else P()
 
 
 # ----------------------------------------------------------------------
@@ -256,11 +337,75 @@ def _lookup_bwd(spec, interpret, tile, ids, g):
 _lookup_diff.defvjp(_lookup_fwd, _lookup_bwd)
 
 
+def _sharded_lookup_impl(spec, interpret, tile, mesh, packed, ids):
+    """shard_map route of the lookup: table blocks P(model), ids
+    routed to their owning shard, per-shard kernel bodies, psum
+    combine.  Out-of-range ids read ZEROS here (no shard owns them)
+    where the single-device kernel clamp-reads a real row — identical
+    through the Embedding layer's validity mask, which is the only
+    sanctioned consumer of out-of-range ids."""
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel import compile as pc
+    from elasticdl_tpu.parallel.mesh import MODEL_AXIS
+
+    axis = table_partition_axis(spec.num_blocks, mesh)
+    local_spec = _shard_local_spec(spec, mesh) if axis else spec
+    data = _batch_spec(ids.shape[0], mesh)
+
+    def body(packed_l, ids_l):
+        if axis is None:
+            return _lookup_impl(spec, interpret, tile, packed_l, ids_l)
+        rows_local = local_spec.vocab_padded
+        start = jax.lax.axis_index(MODEL_AXIS) * rows_local
+        local = ids_l.astype(jnp.int32) - start
+        inshard = (local >= 0) & (local < rows_local)
+        rows = _lookup_impl(
+            local_spec, interpret, tile, packed_l,
+            jnp.where(inshard, local, 0),
+        )
+        rows = rows * inshard[:, None].astype(rows.dtype)
+        # Each valid id is owned by exactly one shard; the psum adds
+        # exact zeros elsewhere, so owner bits pass through untouched.
+        return jax.lax.psum(rows, MODEL_AXIS)
+
+    return pc.shard_map_call(
+        body, mesh,
+        in_specs=(P(axis), data),
+        out_specs=data,
+        check_vma=False,
+    )(packed, ids)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _sharded_lookup_diff(spec, interpret, tile, mesh, packed, ids):
+    return _sharded_lookup_impl(spec, interpret, tile, mesh, packed, ids)
+
+
+def _sharded_lookup_fwd(spec, interpret, tile, mesh, packed, ids):
+    out = _sharded_lookup_impl(spec, interpret, tile, mesh, packed, ids)
+    return out, ids
+
+
+def _sharded_lookup_bwd(spec, interpret, tile, mesh, ids, g):
+    # Same global segment-sum cotangent as the single-device route —
+    # plain XLA scatters, which the SPMD partitioner shards fine (the
+    # custom_vjp keeps the backward OUTSIDE shard_map on purpose).
+    d_packed = pk.grad_accumulate(
+        spec, jnp.zeros(spec.packed_shape, g.dtype), ids, g
+    )
+    return d_packed, jnp.zeros(ids.shape, jax.dtypes.float0)
+
+
+_sharded_lookup_diff.defvjp(_sharded_lookup_fwd, _sharded_lookup_bwd)
+
+
 def fused_lookup(
     spec: PackedSpec,
     packed,
     ids,
     *,
+    mesh=None,
     interpret: Optional[bool] = None,
     tile: int = DEFAULT_IDS_PER_TILE,
 ):
@@ -276,8 +421,15 @@ def fused_lookup(
     validity mask zeroes those positions either way (pinned by
     tests/test_sparse_kernels.py).  Differentiable in the table
     (sparse segment-sum cotangent).
+
+    `mesh`: a multi-device mesh routes through shard_map (per-shard
+    kernel bodies over model-axis table shards, psum combine — module
+    docstring "Sharded dispatch"); None / single device keeps the
+    plain pallas_call.
     """
     interpret = _use_interpret() if interpret is None else interpret
+    if dispatch_route(mesh) == "shard_map":
+        return _sharded_lookup_diff(spec, interpret, tile, mesh, packed, ids)
     return _lookup_diff(spec, interpret, tile, packed, ids)
 
 
@@ -408,6 +560,117 @@ def _dedup_apply_kernel(blocks_ref, lane0_ref, touched_ref, gsum_ref,
     jax.lax.fori_loop(0, tile, body, 0)
 
 
+def _dedup_apply_core(spec, kind, hyper, tables, ids, grads, tr,
+                      interpret, tile):
+    """The dedup prologue + ONE kernel pass over `tables` (packed table
+    first, then slot arrays in _KIND_SLOTS order), all in the given
+    spec's (possibly per-shard) coordinate space.  Returns the updated
+    arrays in operand order."""
+    safe, gsum, touched = pk.dedup_representatives(spec, ids, grads)
+    tch = touched.astype(tables[0].dtype)[:, None]
+    gsum = gsum * tch  # the scatter path's masking, same bits
+
+    n = safe.shape[0]
+    tile = min(tile, _pad_to_tile(max(n, 1), 8))
+    n_pad = _pad_to_tile(max(n, 1), tile)
+    pad = n_pad - n
+    safe_pad = jnp.pad(safe, (0, pad))
+    touched_pad = jnp.pad(touched.astype(jnp.int32), (0, pad))
+    blocks, lane0 = _block_and_lane(spec, safe_pad)
+    if spec.dim != spec.dim_padded:
+        gsum = jnp.pad(gsum, ((0, 0), (0, spec.dim_padded - spec.dim)))
+    gsum_pad = jnp.pad(gsum, ((0, pad), (0, 0)))
+
+    n_tables = len(tables)
+    # Operand order: 3 prefetch scalars, gsum tile, tr scalar, then the
+    # aliased table refs.  input_output_aliases indexes INCLUDE the
+    # prefetch operands.
+    aliases = {5 + t: t for t in range(n_tables)}
+    outs = pl.pallas_call(
+        functools.partial(
+            _dedup_apply_kernel,
+            kind=kind,
+            hyper=hyper,
+            tile=tile,
+            dim_padded=spec.dim_padded,
+            dim=spec.dim,
+            n_tables=n_tables,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(n_pad // tile,),
+            in_specs=[
+                pl.BlockSpec((tile, spec.dim_padded), lambda g, *_: (g, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ]
+            + [pl.BlockSpec(memory_space=pltpu.ANY)] * n_tables,
+            out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * n_tables,
+            scratch_shapes=[
+                pltpu.VMEM(
+                    (n_tables, 1, spec.block_width), tables[0].dtype
+                ),
+                pltpu.SemaphoreType.DMA((n_tables,)),
+                pltpu.SemaphoreType.DMA((n_tables,)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(t.shape, t.dtype) for t in tables
+        ],
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(blocks, lane0, touched_pad, gsum_pad, tr, *tables)
+    return tuple(outs)
+
+
+def _sharded_dedup_apply(spec, kind, hyper, tables, ids, grads, tr, mesh,
+                         interpret, tile):
+    """shard_map route of the optimizer apply: table + slot blocks
+    P(model), the batch (ids, grads) all-gathered over `data` so every
+    replica of a table shard applies the IDENTICAL update (the dedup
+    sees the same global occurrence order as single-device — same
+    summed-gradient bits), ids routed to their owning shard (-1 =
+    dropped by the dedup prologue, exactly like padding ids).  No
+    cross-shard combine: each shard owns its rows' writes."""
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel import compile as pc
+    from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    axis = table_partition_axis(spec.num_blocks, mesh)
+    local_spec = _shard_local_spec(spec, mesh) if axis else spec
+    data = _batch_spec(ids.shape[0], mesh)
+    data_sharded = data != P()
+
+    def body(ids_l, grads_l, tr_l, *tables_l):
+        if data_sharded:
+            ids_g = jax.lax.all_gather(ids_l, DATA_AXIS, tiled=True)
+            grads_g = jax.lax.all_gather(grads_l, DATA_AXIS, tiled=True)
+        else:
+            ids_g, grads_g = ids_l, grads_l
+        if axis is None:
+            return _dedup_apply_core(
+                spec, kind, hyper, tables_l, ids_g, grads_g, tr_l,
+                interpret, tile,
+            )
+        rows_local = local_spec.vocab_padded
+        start = jax.lax.axis_index(MODEL_AXIS) * rows_local
+        local = ids_g.astype(jnp.int32) - start
+        inshard = (local >= 0) & (local < rows_local)
+        routed = jnp.where(inshard, local, -1)
+        return _dedup_apply_core(
+            local_spec, kind, hyper, tables_l, routed, grads_g, tr_l,
+            interpret, tile,
+        )
+
+    table_p = P(axis) if axis else P()
+    return pc.shard_map_call(
+        body, mesh,
+        in_specs=(data, data, P()) + (table_p,) * len(tables),
+        out_specs=(table_p,) * len(tables),
+        check_vma=False,
+    )(ids, grads, tr, *tables)
+
+
 def fused_dedup_apply(
     spec: PackedSpec,
     kind: str,
@@ -417,6 +680,7 @@ def fused_dedup_apply(
     ids,
     grads,
     *,
+    mesh=None,
     interpret: Optional[bool] = None,
     tile: int = DEFAULT_IDS_PER_TILE,
 ):
@@ -444,6 +708,10 @@ def fused_dedup_apply(
     plus 3-4 expand_updates scatters, each an ``[n, 128]`` HBM
     intermediate — collapse into one kernel that round-trips only the
     touched rows' 512 B storage rows through VMEM.
+
+    `mesh`: a multi-device mesh routes the whole pass through shard_map
+    (module docstring "Sharded dispatch") — same arithmetic per shard,
+    identical update on every replica of a table shard.
     """
     if kind == "adam" and "t" not in slots:
         kind = "adam_global"
@@ -453,24 +721,10 @@ def fused_dedup_apply(
     slot_names = _KIND_SLOTS[kind]
     new_slots = dict(slots)
 
-    safe, gsum, touched = pk.dedup_representatives(spec, ids, grads)
-    tch = touched.astype(packed_table.dtype)[:, None]
-    gsum = gsum * tch  # the scatter path's masking, same bits
-
-    n = safe.shape[0]
-    tile = min(tile, _pad_to_tile(max(n, 1), 8))
-    n_pad = _pad_to_tile(max(n, 1), tile)
-    pad = n_pad - n
-    safe_pad = jnp.pad(safe, (0, pad))
-    touched_pad = jnp.pad(touched.astype(jnp.int32), (0, pad))
-    blocks, lane0 = _block_and_lane(spec, safe_pad)
-    if spec.dim != spec.dim_padded:
-        gsum = jnp.pad(gsum, ((0, 0), (0, spec.dim_padded - spec.dim)))
-    gsum_pad = jnp.pad(gsum, ((0, pad), (0, 0)))
-
     if kind == "adam_global":
         # Global bias correction: one shared apply counter, incremented
         # unconditionally per apply (the reference Go Adam's contract).
+        # Replicated scalar — updated OUTSIDE any shard_map.
         t_global = slots["t_global"] + 1.0
         new_slots["t_global"] = t_global
         tr = jnp.reshape(t_global.astype(jnp.float32), (1, 1))
@@ -478,44 +732,15 @@ def fused_dedup_apply(
         tr = jnp.zeros((1, 1), jnp.float32)  # per-row tr reads in-kernel
 
     tables = (packed_table,) + tuple(slots[name] for name in slot_names)
-    n_tables = len(tables)
-    # Operand order: 3 prefetch scalars, gsum tile, tr scalar, then the
-    # aliased table refs.  input_output_aliases indexes INCLUDE the
-    # prefetch operands.
-    aliases = {5 + t: t for t in range(n_tables)}
-    outs = pl.pallas_call(
-        functools.partial(
-            _dedup_apply_kernel,
-            kind=kind,
-            hyper=hyper,
-            tile=tile,
-            dim_padded=spec.dim_padded,
-            dim=spec.dim,
-            n_tables=n_tables,
-        ),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
-            grid=(n_pad // tile,),
-            in_specs=[
-                pl.BlockSpec((tile, spec.dim_padded), lambda g, *_: (g, 0)),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-            ]
-            + [pl.BlockSpec(memory_space=pltpu.ANY)] * n_tables,
-            out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * n_tables,
-            scratch_shapes=[
-                pltpu.VMEM(
-                    (n_tables, 1, spec.block_width), packed_table.dtype
-                ),
-                pltpu.SemaphoreType.DMA((n_tables,)),
-                pltpu.SemaphoreType.DMA((n_tables,)),
-            ],
-        ),
-        out_shape=[
-            jax.ShapeDtypeStruct(t.shape, t.dtype) for t in tables
-        ],
-        input_output_aliases=aliases,
-        interpret=interpret,
-    )(blocks, lane0, touched_pad, gsum_pad, tr, *tables)
+    if dispatch_route(mesh) == "shard_map":
+        outs = _sharded_dedup_apply(
+            spec, kind, hyper, tables, ids, grads, tr, mesh, interpret,
+            tile,
+        )
+    else:
+        outs = _dedup_apply_core(
+            spec, kind, hyper, tables, ids, grads, tr, interpret, tile
+        )
     new_table = outs[0]
     for name, arr in zip(slot_names, outs[1:]):
         new_slots[name] = arr
@@ -651,7 +876,10 @@ def _fm_fwd(spec, interpret, batch_tile, packed, bet, ids, valid):
     return out, (acts, ids, valid)
 
 
-def _fm_bwd(spec, interpret, batch_tile, res, cots):
+def _fm_bwd_math(spec, res, cots):
+    """Shared backward of both FM routes (single-device and sharded):
+    pure XLA ops over the GLOBAL residuals, so the custom_vjp never
+    transposes through shard_map."""
     acts, ids, valid = res
     dtype = acts.dtype
     d_acts, d_first, d_sumv, d_sumsq = cots
@@ -681,7 +909,74 @@ def _fm_bwd(spec, interpret, batch_tile, res, cots):
     )
 
 
+def _fm_bwd(spec, interpret, batch_tile, res, cots):
+    return _fm_bwd_math(spec, res, cots)
+
+
 _fm_diff.defvjp(_fm_fwd, _fm_bwd)
+
+
+def _sharded_fm_impl(spec, interpret, batch_tile, mesh, packed, bet, ids,
+                     valid):
+    """shard_map route of the FM kernel: table blocks P(model), batch
+    P(data), per-shard validity = valid AND owned-here, psum combine.
+    Field sums are additive with one owning shard per field, so the
+    combined quadruple matches single-device up to the documented
+    reduction-order tolerance (psum adds exact zeros for acts)."""
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel import compile as pc
+    from elasticdl_tpu.parallel.mesh import MODEL_AXIS
+
+    axis = table_partition_axis(spec.num_blocks, mesh)
+    local_spec = _shard_local_spec(spec, mesh) if axis else spec
+    data = _batch_spec(ids.shape[0], mesh)
+
+    def body(packed_l, bet_l, ids_l, valid_l):
+        if axis is None:
+            return _fm_impl(
+                spec, interpret, batch_tile, packed_l, bet_l, ids_l,
+                valid_l,
+            )
+        rows_local = local_spec.vocab_padded
+        start = jax.lax.axis_index(MODEL_AXIS) * rows_local
+        local = ids_l.astype(jnp.int32) - start
+        inshard = valid_l & (local >= 0) & (local < rows_local)
+        out = _fm_impl(
+            local_spec, interpret, batch_tile, packed_l, bet_l,
+            jnp.where(inshard, local, 0), inshard,
+        )
+        return tuple(jax.lax.psum(x, MODEL_AXIS) for x in out)
+
+    return pc.shard_map_call(
+        body, mesh,
+        in_specs=(P(axis), data, data, data),
+        out_specs=(data, data, data, data),
+        check_vma=False,
+    )(packed, bet, ids, valid)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _sharded_fm_diff(spec, interpret, batch_tile, mesh, packed, bet, ids,
+                     valid):
+    return _sharded_fm_impl(
+        spec, interpret, batch_tile, mesh, packed, bet, ids, valid
+    )
+
+
+def _sharded_fm_fwd(spec, interpret, batch_tile, mesh, packed, bet, ids,
+                    valid):
+    out = _sharded_fm_impl(
+        spec, interpret, batch_tile, mesh, packed, bet, ids, valid
+    )
+    return out, (out[0], ids, valid)
+
+
+def _sharded_fm_bwd(spec, interpret, batch_tile, mesh, res, cots):
+    return _fm_bwd_math(spec, res, cots)
+
+
+_sharded_fm_diff.defvjp(_sharded_fm_fwd, _sharded_fm_bwd)
 
 
 def fused_lookup_fm(
@@ -691,6 +986,7 @@ def fused_lookup_fm(
     ids,
     valid,
     *,
+    mesh=None,
     interpret: Optional[bool] = None,
     batch_tile: int = DEFAULT_FM_BATCH_TILE,
 ):
@@ -720,6 +1016,10 @@ def fused_lookup_fm(
             f"(1 linear lane + FM lanes), got dim={spec.dim}"
         )
     interpret = _use_interpret() if interpret is None else interpret
+    if dispatch_route(mesh) == "shard_map":
+        return _sharded_fm_diff(
+            spec, interpret, batch_tile, mesh, packed, bet, ids, valid
+        )
     return _fm_diff(spec, interpret, batch_tile, packed, bet, ids, valid)
 
 

@@ -23,6 +23,7 @@ import numpy as np
 import optax
 
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.parallel import compile as pc
 from elasticdl_tpu.parallel import sharding as shd
 from elasticdl_tpu.worker.trainer import TrainState, _model_apply
 
@@ -100,61 +101,84 @@ class DataParallelTrainer:
         self._train_window_jit = None
         self._eval_step = None
 
-    # -- sharding layout -------------------------------------------------
+    # -- sharding layout (declarative rule table, parallel/compile.py) --
 
-    def _leaf_sharding(self, leaf):
-        """FSDP placement for one dense leaf: dim0 over the data axis when
-        it divides evenly and the leaf is worth sharding."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    def _partition_rules(self) -> pc.RuleTable:
+        """The dense trainer's placement policy as a rule table.
+        Replicated mode is one catch-all entry; FSDP shards
+        params/opt_state dim0 over `data` when the leaf divides the
+        axis and is worth sharding (shape-aware callable rule — the
+        FSDP_MIN_LEAF/divisibility policy reads as ONE table entry).
+        Scalars and everything else (step counter, batch stats)
+        replicate."""
+        from jax.sharding import PartitionSpec as P
 
         from elasticdl_tpu.parallel.mesh import DATA_AXIS
 
+        if self._dense_sharding == "replicated":
+            return pc.RuleTable([pc.Rule(".*", P())], name="dp-replicated")
+        dp = self._dp
+        min_leaf = self.FSDP_MIN_LEAF
+
+        def fsdp_leaf(path, shape):
+            if shape[0] % dp == 0 and int(np.prod(shape)) >= min_leaf:
+                return P(DATA_AXIS, *([None] * (len(shape) - 1)))
+            return P()
+
+        return pc.RuleTable(
+            [
+                pc.Rule(r"^(params|opt_state)(/|$)", fsdp_leaf),
+                pc.Rule(".*", P()),
+            ],
+            name="dp-fsdp",
+        )
+
+    def _plan(self) -> pc.CompilePlan:
+        return pc.CompilePlan(
+            self._mesh, self._partition_rules(), trainer="dp_trainer"
+        )
+
+    def _state_shardings(self, state: TrainState, plan=None):
         # Works on concrete arrays AND jax.eval_shape's ShapeDtypeStructs
         # (the sharded-init path computes shardings from shapes alone).
-        shape = tuple(getattr(leaf, "shape", None) or np.shape(leaf))
-        if (
-            self._dense_sharding == "fsdp"
-            and len(shape) >= 1
-            and shape[0] % self._dp == 0
-            and int(np.prod(shape)) >= self.FSDP_MIN_LEAF
-        ):
-            spec = P(DATA_AXIS, *([None] * (len(shape) - 1)))
-            return NamedSharding(self._mesh, spec)
-        return shd.replicated(self._mesh)
-
-    def _state_shardings(self, state: TrainState):
-        repl = shd.replicated(self._mesh)
-        if self._dense_sharding == "replicated":
-            return jax.tree.map(lambda _: repl, state)
+        plan = plan or self._plan()
+        tree = plan.state_shardings({
+            "step": state.step,
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "model_state": state.model_state,
+        })
         return TrainState(
-            step=repl,
-            params=jax.tree.map(self._leaf_sharding, state.params),
-            opt_state=jax.tree.map(self._leaf_sharding, state.opt_state),
-            model_state=jax.tree.map(lambda _: repl, state.model_state),
+            tree["step"], tree["params"], tree["opt_state"],
+            tree["model_state"],
         )
 
     def _place_state(self, state: TrainState) -> TrainState:
         return shd.put(state, self._state_shardings(state))
 
     def _compile_steps(self, state: TrainState):
-        repl = shd.replicated(self._mesh)
+        plan = self._plan()
+        repl = plan.replicated()
         batch = shd.batch_sharded(self._mesh)
         window = shd.window_sharded(self._mesh)
-        state_shardings = self._state_shardings(state)
-        self._train_step = jax.jit(
+        state_shardings = self._state_shardings(state, plan)
+        self._train_step = plan.compile(
             self._train_step_impl,
+            name="dp_train_step",
             in_shardings=(state_shardings, batch, batch, batch),
             out_shardings=(state_shardings, repl),
             donate_argnums=(0,),
         )
-        self._train_window_jit = jax.jit(
+        self._train_window_jit = plan.compile(
             self._train_window_impl,
+            name="dp_train_window",
             in_shardings=(state_shardings, window, window, window),
             out_shardings=(state_shardings, repl),
             donate_argnums=(0,),
         )
-        self._eval_step = jax.jit(
+        self._eval_step = plan.compile(
             self._eval_step_impl,
+            name="dp_eval_step",
             in_shardings=(state_shardings, batch),
             out_shardings=batch,
         )
@@ -249,17 +273,20 @@ class DataParallelTrainer:
                 # packed-table specs (host-bound, layout-irrelevant) and
                 # the param computations feeding them are dead-code-
                 # eliminated — declaring shardings here would force the
-                # full init to compile.
-                specs = jax.jit(  # noqa-invariant: sharding-coverage
+                # full init to compile (jit_utility is the compile
+                # layer's sanctioned non-step passthrough).
+                specs = pc.jit_utility(
                     lambda r, f: self._make_state(r, f)[1]
                 )(rng, features)
                 self._state = self._restore_sharded(state_shapes)
             else:
-                repl = shd.replicated(self._mesh)
-                init = jax.jit(
+                plan = self._plan()
+                repl = plan.replicated()
+                init = plan.compile(
                     self._make_state,
+                    name="dp_init",
                     out_shardings=(
-                        self._state_shardings(state_shapes),
+                        self._state_shardings(state_shapes, plan),
                         jax.tree.map(lambda _: repl, _specs_shapes),
                     ),
                 )

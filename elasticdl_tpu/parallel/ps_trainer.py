@@ -37,6 +37,7 @@ from elasticdl_tpu.layers.embedding import (
     SPECS_COLLECTION,
     VOCAB_AXIS,
 )
+from elasticdl_tpu.parallel import compile as pc
 from elasticdl_tpu.parallel import packed as pk
 from elasticdl_tpu.parallel.packed import PackedSpec
 from elasticdl_tpu.parallel import sharding as shd
@@ -117,24 +118,14 @@ class ShardedEmbeddingTrainer:
 
         self._sparse_kernel_requested = sparse_kernel or ske.default_kernel()
         resolved = ske.resolve_kernel(sparse_kernel)
-        if resolved == "fused" and int(mesh.devices.size) > 1:
-            # Config ERROR, not a silent fallback: pallas_call is not
-            # SPMD-partitionable, and the trainer cannot retro-switch
-            # the MODEL's Embedding layers (built with their own
-            # sparse_kernel), so "falling back" here would run fused
-            # lookups over a sharded table anyway while journaling
-            # kernel=xla — the misattribution the journal event exists
-            # to prevent.  worker/main downgrades the whole job (layers
-            # + optimizer + journal) consistently BEFORE the model is
-            # built; direct constructions must pick one engine.
-            raise ValueError(
-                f"sparse_kernel=fused on a {int(mesh.devices.size)}-device "
-                "mesh: the fused kernels target single-device tables "
-                "(v1; pallas_call has no SPMD partitioning rule — "
-                "docs/design.md 'Fused sparse kernels'). Use "
-                "sparse_kernel='xla' (and build the model with the same "
-                "kernel), or a single-device mesh."
-            )
+        # Fused dispatch route: single_device keeps the plain pallas_call
+        # path; a multi-device mesh routes every fused kernel through
+        # shard_map (ops/sparse_embedding.py "Sharded dispatch") —
+        # tables shard over the `model` axis, ids route to their owning
+        # shard, and the combine is a psum.  The v1 multi-device config
+        # ERROR (pallas_call has no SPMD partitioning rule) is gone:
+        # shard_map IS the partitioning rule.
+        self._sparse_route = ske.dispatch_route(mesh)
         if resolved == "fused":
             if self._emb_tx.remake is None:
                 logger.warning(
@@ -144,7 +135,30 @@ class ShardedEmbeddingTrainer:
                     self._emb_tx.name,
                 )
             else:
-                self._emb_tx = self._emb_tx.remake("fused")
+                self._emb_tx = self._remake_fused(self._emb_tx, mesh)
+            if (
+                self._sparse_route == "shard_map"
+                and ske.dispatch_mesh() is not mesh
+            ):
+                # The trainer cannot introspect the MODEL's Embedding
+                # layers (created inside @nn.compact), so it cannot
+                # verify they carry this mesh.  A layer left at
+                # mesh=None in a multi-device job would trace an
+                # unpartitionable pallas_call into the SPMD step — the
+                # failure the old config error guarded.  worker/main
+                # registers the process default; direct constructions
+                # must thread mesh= into the model.  Leave the
+                # breadcrumb the eventual compile error won't.
+                logger.warning(
+                    "sparse_kernel=fused on a %d-device mesh: the fused "
+                    "kernels dispatch through shard_map ONLY where the "
+                    "model's Embedding layers were built with this mesh "
+                    "(mesh= field, or ske.set_dispatch_mesh as "
+                    "worker/main does).  If a layer was built without "
+                    "it, the step will fail to compile — docs/design.md "
+                    "'Declarative sharding'.",
+                    int(mesh.devices.size),
+                )
         self._sparse_kernel = resolved
         if sparse_apply_every == "auto":
             # Resolved at ensure_initialized, the first point the
@@ -168,6 +182,37 @@ class ShardedEmbeddingTrainer:
         self._pending_sharded_restore: Optional[Tuple[Any, int]] = None
         self._train_step = None  # jitted lazily once shardings are known
         self._eval_step = None
+
+    def _remake_fused(self, emb_tx: SparseOptimizer, mesh):
+        """Rebuild the optimizer in fused mode, threading the dispatch
+        mesh when its remake hook accepts one (signature-inspected — no
+        exception swallowing).  A pre-mesh hook is fine on a single
+        device but a hard ERROR on a multi-device mesh: a mesh-less
+        fused apply over model-sharded tables would trace an
+        unpartitionable pallas_call into the SPMD step while the
+        journal reports route=shard_map — the misattribution the
+        journal event exists to prevent."""
+        import inspect
+
+        try:
+            params = inspect.signature(emb_tx.remake).parameters
+            accepts_mesh = "mesh" in params or any(
+                p.kind == p.VAR_KEYWORD for p in params.values()
+            )
+        except (TypeError, ValueError):
+            accepts_mesh = False
+        if accepts_mesh:
+            return emb_tx.remake("fused", mesh=mesh)
+        if self._sparse_route == "shard_map":
+            raise ValueError(
+                f"sparse_kernel=fused on a {int(mesh.devices.size)}-"
+                f"device mesh needs an embedding optimizer whose remake "
+                f"hook accepts mesh= (got {emb_tx.name!r} with a "
+                "mode-only hook) — the fused apply must dispatch "
+                "through shard_map to run over model-sharded tables "
+                "(docs/design.md 'Declarative sharding')"
+            )
+        return emb_tx.remake("fused")
 
     # -- public surface (mirrors DataParallelTrainer) -------------------
 
@@ -209,47 +254,74 @@ class ShardedEmbeddingTrainer:
     def step(self) -> int:
         return self._host_step
 
-    # -- sharding layout -----------------------------------------------
+    # -- sharding layout (declarative rule table, parallel/compile.py) --
 
-    def _table_sharding(self, dim0: int, ndim: int):
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    def _partition_rules(self) -> pc.RuleTable:
+        """PS-mode placement policy as a rule table: dense state (step,
+        params, opt_state, model_state) replicates; embedding tables
+        and their table-shaped optimizer slots shard on dim0 (storage
+        blocks).  The block placement is the ONE shape-aware entry:
 
-        # Storage blocks across the WHOLE mesh: maximum HBM capacity, the
-        # analogue of partitioning one table over every PS pod.  Tables too
-        # small to split evenly (fewer blocks than devices) replicate — they
-        # are by definition tiny.
-        total = int(self._mesh.devices.size)
-        if dim0 % total != 0:
-            return shd.replicated(self._mesh)
-        spec = P((DATA_AXIS, MODEL_AXIS), *([None] * (ndim - 1)))
-        return NamedSharding(self._mesh, spec)
+        - xla engine: blocks across the WHOLE mesh (`data` x `model`) —
+          maximum HBM capacity, the analogue of partitioning one table
+          over every PS pod; tables too small to split evenly replicate
+          (they are by definition tiny).
+        - fused engine: blocks over the `model` axis only (replicated
+          across `data`) — the layout the shard_map'd kernel dispatch
+          declares (ops/sparse_embedding.table_partition_axis), so the
+          per-shard pallas bodies see exactly their resident blocks
+          with no per-step resharding.
 
-    def _state_shardings(self, state: PSTrainState):
-        repl = shd.replicated(self._mesh)
-        tables = {
-            key: self._table_sharding(np.shape(value)[0], np.ndim(value))
-            for key, value in state.tables.items()
-        }
-        slots = {
-            key: {
-                # Scalar slots (e.g. adam's global-bias step counter)
-                # replicate; table-shaped slots shard with their table.
-                name: (
-                    self._table_sharding(np.shape(value)[0], np.ndim(value))
-                    if np.ndim(value)
-                    else repl
-                )
-                for name, value in group.items()
-            }
-            for key, group in state.slots.items()
-        }
+        Scalar slots (adam's global-bias counter) replicate via the
+        table's scalar default."""
+        from jax.sharding import PartitionSpec as P
+
+        from elasticdl_tpu.ops import sparse_embedding as ske
+
+        fused = self._sparse_kernel == "fused"
+        mesh = self._mesh
+        total = int(mesh.devices.size)
+
+        def table_blocks(path, shape):
+            if fused:
+                axis = ske.table_partition_axis(shape[0], mesh)
+                if axis is None:
+                    return P()
+                return P(axis, *([None] * (len(shape) - 1)))
+            if shape[0] % total != 0:
+                return P()
+            return P((DATA_AXIS, MODEL_AXIS), *([None] * (len(shape) - 1)))
+
+        return pc.RuleTable(
+            [
+                pc.Rule(r"^(tables|slots)(/|$)", table_blocks),
+                pc.Rule(".*", P()),
+            ],
+            name="ps-fused" if fused else "ps-xla",
+        )
+
+    def _plan(self) -> pc.CompilePlan:
+        return pc.CompilePlan(
+            self._mesh, self._partition_rules(), trainer="ps_trainer"
+        )
+
+    def _state_shardings(self, state: PSTrainState, plan=None):
+        plan = plan or self._plan()
+        tree = plan.state_shardings({
+            "step": state.step,
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "model_state": state.model_state,
+            "tables": state.tables,
+            "slots": state.slots,
+        })
         return PSTrainState(
-            step=repl,
-            params=jax.tree.map(lambda _: repl, state.params),
-            opt_state=jax.tree.map(lambda _: repl, state.opt_state),
-            model_state=jax.tree.map(lambda _: repl, state.model_state),
-            tables=tables,
-            slots=slots,
+            step=tree["step"],
+            params=tree["params"],
+            opt_state=tree["opt_state"],
+            model_state=tree["model_state"],
+            tables=tree["tables"],
+            slots=tree["slots"],
         )
 
     @staticmethod
@@ -393,10 +465,18 @@ class ShardedEmbeddingTrainer:
         # was measured on (schema: scripts/validate_journal.py).
         from elasticdl_tpu import obs
 
+        # `route` replaces the removed multi-device downgrade warning:
+        # for the fused engine it names the dispatch the kernels take
+        # (single_device pallas_call vs shard_map over the mesh); the
+        # xla engine always runs the SPMD partitioner ('xla').
         obs.journal().record(
             "sparse_kernel_selected",
             kernel=self._sparse_kernel,
             requested=self._sparse_kernel_requested,
+            route=(
+                self._sparse_route if self._sparse_kernel == "fused"
+                else "xla"
+            ),
             optimizer=self._emb_tx.name,
             tables=len(tables),
             table_rows=total_rows,
@@ -405,24 +485,28 @@ class ShardedEmbeddingTrainer:
         return self._state
 
     def _compile_steps(self):
-        repl = shd.replicated(self._mesh)
+        plan = self._plan()
+        repl = plan.replicated()
         batch = shd.batch_sharded(self._mesh)
         window = shd.window_sharded(self._mesh)
-        state_shardings = self._state_shardings(self._state)
-        self._train_step = jax.jit(
+        state_shardings = self._state_shardings(self._state, plan)
+        self._train_step = plan.compile(
             self._train_step_impl,
+            name="ps_train_step",
             in_shardings=(state_shardings, batch, batch, batch),
             out_shardings=(state_shardings, (repl, repl)),
             donate_argnums=(0,),
         )
-        self._train_window = jax.jit(
+        self._train_window = plan.compile(
             self._train_window_impl,
+            name="ps_train_window",
             in_shardings=(state_shardings, window, window, window),
             out_shardings=(state_shardings, (repl, repl)),
             donate_argnums=(0,),
         )
-        self._eval_step = jax.jit(
+        self._eval_step = plan.compile(
             self._eval_step_impl,
+            name="ps_eval_step",
             in_shardings=(state_shardings, batch),
             out_shardings=batch,
         )

@@ -34,19 +34,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from elasticdl_tpu.parallel import compile as pc
 from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 NEG_INF = -1e30
-
-
-def _shard_map():
-    """jax.shard_map (0.8+) with the jax.experimental fallback."""
-    fn = getattr(jax, "shard_map", None)
-    if fn is not None:
-        return fn
-    from jax.experimental.shard_map import shard_map as fn
-
-    return fn
 
 
 def _attn_block(q, k, v, scale, q_pos, k_pos, causal, m, l, acc):
@@ -465,25 +456,25 @@ def make_ring_attention(mesh, *, axis: str = MODEL_AXIS,
     `zigzag_order` (and un-permuting outputs with `inverse_order`).
     `impl` selects the per-step block engine (see _ring_dispatch)."""
     spec = P(DATA_AXIS, axis, None, None)
-    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     fn = partial(
         _ring_dispatch, axis_name=axis, causal=causal, layout=layout,
         impl=impl,
     )
-    sm = _shard_map()
-    if impl == "xla":
-        # Keep shard_map's varying-axes checking on the pure-XLA engine.
-        return sm(fn, **kwargs)
-    # check_vma off only where the pallas engine can be selected: kernel
-    # interpret mode (CPU tests/dryruns) trips a jax limitation inside
-    # the kernel interpreter ("Primitive dynamic_slice requires varying
-    # manual axes to match ... as a temporary workaround pass
+    # Built through the compile layer's shard_map shim (the one place
+    # that owns the jax.shard_map fallback + check_vma/check_rep
+    # rename).  check_vma stays ON for the pure-XLA engine; it is off
+    # only where the pallas engine can be selected — kernel interpret
+    # mode (CPU tests/dryruns) trips a jax limitation inside the kernel
+    # interpreter ("Primitive dynamic_slice requires varying manual
+    # axes to match ... as a temporary workaround pass
     # check_vma=False"); collective placement is pinned by the
     # parity+HLO-structure tests instead.
-    try:
-        return sm(fn, check_vma=False, **kwargs)
-    except TypeError:  # older jax: the flag was called check_rep
-        return sm(fn, check_rep=False, **kwargs)
+    return pc.shard_map_call(
+        fn, mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=None if impl == "xla" else False,
+    )
 
 
 def ring_self_attention(

@@ -89,12 +89,15 @@ class SparseOptimizer:
     # step (BASELINE.md).  apply_acc serves host-side/offline applies and
     # callers that already hold an accumulated gradient table.
     apply_acc: Optional[Callable] = None
-    # remake(mode) -> SparseOptimizer: this optimizer rebuilt with a
-    # different apply-mode but identical hyperparameters.  The trainer
-    # uses it to honor --sparse_kernel=fused on an optimizer the model
-    # spec constructed with the default mode (ps_trainer can't mutate a
-    # frozen dataclass whose apply closures captured the mode).
-    remake: Optional[Callable[[str], "SparseOptimizer"]] = None
+    # remake(mode, mesh=None) -> SparseOptimizer: this optimizer rebuilt
+    # with a different apply-mode but identical hyperparameters.  The
+    # trainer uses it to honor --sparse_kernel=fused on an optimizer the
+    # model spec constructed with the default mode (ps_trainer can't
+    # mutate a frozen dataclass whose apply closures captured the mode).
+    # `mesh` selects the fused kernels' dispatch route: a multi-device
+    # mesh routes fused_dedup_apply through shard_map
+    # (ops/sparse_embedding.py "Sharded dispatch").
+    remake: Optional[Callable[..., "SparseOptimizer"]] = None
 
     # -- logical-shape conveniences (tests, host tools) -----------------
 
@@ -158,14 +161,16 @@ def select_mode(spec: PackedSpec, n_ids: int, mode: str) -> str:
     )
 
 
-def _fused_apply(kind: str, hyper: dict):
+def _fused_apply(kind: str, hyper: dict, mesh=None):
     """apply() via the fused Pallas dedup+apply kernel.  Import at
-    construction time (host), not trace time."""
+    construction time (host), not trace time.  `mesh` routes the
+    kernel's dispatch (single-device pallas_call vs shard_map over a
+    multi-device mesh)."""
     from elasticdl_tpu.ops import sparse_embedding as ske
 
     def apply(spec, packed_table, slots, ids, grads):
         return ske.fused_dedup_apply(
-            spec, kind, hyper, packed_table, slots, ids, grads
+            spec, kind, hyper, packed_table, slots, ids, grads, mesh=mesh
         )
 
     return apply
@@ -196,7 +201,8 @@ def _dual_apply(mode: str, stream_apply_acc, scatter_apply,
     return apply
 
 
-def sgd(learning_rate: float = 0.01, mode: str = "auto") -> SparseOptimizer:
+def sgd(learning_rate: float = 0.01, mode: str = "auto",
+        mesh=None) -> SparseOptimizer:
     lr = learning_rate
     hyper = {"learning_rate": lr}
 
@@ -208,7 +214,7 @@ def sgd(learning_rate: float = 0.01, mode: str = "auto") -> SparseOptimizer:
         # stream and the scatter path — no dedup needed.
         return pk.scatter_add(spec, packed_table, ids, -lr * grads), slots
 
-    fused = _fused_apply("sgd", hyper)
+    fused = _fused_apply("sgd", hyper, mesh)
 
     def apply(spec, packed_table, slots, ids, grads):
         if select_mode(spec, ids.shape[0], mode) == "fused":
@@ -222,7 +228,7 @@ def sgd(learning_rate: float = 0.01, mode: str = "auto") -> SparseOptimizer:
 
     return SparseOptimizer(
         "sgd", init_slots, apply, hyper, apply_acc,
-        remake=lambda m: sgd(learning_rate, mode=m),
+        remake=lambda m, mesh=None: sgd(learning_rate, mode=m, mesh=mesh),
     )
 
 
@@ -231,6 +237,7 @@ def momentum(
     mu: float = 0.9,
     nesterov: bool = False,
     mode: str = "auto",
+    mesh=None,
 ) -> SparseOptimizer:
     lr = learning_rate
 
@@ -264,15 +271,18 @@ def momentum(
     return SparseOptimizer(
         "momentum", init_slots,
         _dual_apply(mode, stream_apply_acc, scatter_apply,
-                    _fused_apply("momentum", hyper)),
+                    _fused_apply("momentum", hyper, mesh)),
         hyper,
         stream_apply_acc,
-        remake=lambda m: momentum(learning_rate, mu, nesterov, mode=m),
+        remake=lambda m, mesh=None: momentum(
+            learning_rate, mu, nesterov, mode=m, mesh=mesh
+        ),
     )
 
 
 def adagrad(
-    learning_rate: float = 0.01, epsilon: float = 1e-7, mode: str = "auto"
+    learning_rate: float = 0.01, epsilon: float = 1e-7, mode: str = "auto",
+    mesh=None,
 ) -> SparseOptimizer:
     lr = learning_rate
 
@@ -299,10 +309,12 @@ def adagrad(
     return SparseOptimizer(
         "adagrad", init_slots,
         _dual_apply(mode, stream_apply_acc, scatter_apply,
-                    _fused_apply("adagrad", hyper)),
+                    _fused_apply("adagrad", hyper, mesh)),
         hyper,
         stream_apply_acc,
-        remake=lambda m: adagrad(learning_rate, epsilon, mode=m),
+        remake=lambda m, mesh=None: adagrad(
+            learning_rate, epsilon, mode=m, mesh=mesh
+        ),
     )
 
 
@@ -313,6 +325,7 @@ def adam(
     epsilon: float = 1e-8,
     mode: str = "auto",
     bias_correction: str = "per_row",
+    mesh=None,
 ) -> SparseOptimizer:
     """Sparse Adam.
 
@@ -409,12 +422,12 @@ def adam(
     return SparseOptimizer(
         "adam", init_slots,
         _dual_apply(mode, stream_apply_acc, scatter_apply,
-                    _fused_apply("adam", hyper)),
+                    _fused_apply("adam", hyper, mesh)),
         hyper,
         stream_apply_acc,
-        remake=lambda m: adam(
+        remake=lambda m, mesh=None: adam(
             learning_rate, beta_1, beta_2, epsilon, mode=m,
-            bias_correction=bias_correction,
+            bias_correction=bias_correction, mesh=mesh,
         ),
     )
 

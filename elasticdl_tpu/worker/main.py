@@ -171,37 +171,21 @@ def _build_collective_worker(
         MeshConfig(model=getattr(args, "mesh_model_axis", 1))
     )
     # --sparse_kernel resolution is STRATEGY-INDEPENDENT (the Embedding
-    # layers run under every trainer): on a multi-device mesh the fused
-    # kernels are unavailable (v1 — pallas_call is not
-    # SPMD-partitionable, so a fused lookup over sharded/replicated
-    # tables inside an SPMD-jitted step has no partitioning rule).
-    # Downgrade the WHOLE job consistently — process default,
-    # model_params (the layer side), and the PS trainer arg — BEFORE
-    # the model is built, so layers, optimizer, and the
-    # sparse_kernel_selected journal record all agree.
+    # layers run under every trainer).  Multi-device meshes run the
+    # fused kernels through the shard_map dispatch
+    # (ops/sparse_embedding.py "Sharded dispatch") — the v1 whole-job
+    # downgrade to xla is gone.  Register BOTH process defaults BEFORE
+    # the model is built: the kernel default (Embedding layers that did
+    # not thread sparse_kernel explicitly resolve it at trace time; zoo
+    # models that declare the param get the same value via model_params,
+    # common/model_utils.py) and the dispatch mesh (layers that did not
+    # thread `mesh` still route per-shard kernel bodies instead of
+    # tracing an unpartitionable pallas_call into an SPMD program).
     from elasticdl_tpu.ops import sparse_embedding as ske
 
     sparse_kernel = getattr(args, "sparse_kernel", "auto") or "auto"
-    if (
-        int(mesh.devices.size) > 1
-        and ske.resolve_kernel(sparse_kernel) == "fused"
-    ):
-        logger.warning(
-            "--sparse_kernel=%s requested on a %d-device mesh: the "
-            "fused kernels target single-device tables (v1, "
-            "docs/design.md 'Fused sparse kernels'); running the "
-            "xla sparse path end to end",
-            sparse_kernel, int(mesh.devices.size),
-        )
-        sparse_kernel = "xla"
-        args.sparse_kernel = "xla"
-        if "sparse_kernel" in model_spec.model_params:
-            model_spec.model_params["sparse_kernel"] = "xla"
-    # Process default FIRST (before build_model): Embedding layers that
-    # did not thread sparse_kernel explicitly resolve against it at
-    # trace time; zoo models that declare the param get the same value
-    # via model_params (common/model_utils.py).
     ske.set_default_kernel(sparse_kernel)
+    ske.set_dispatch_mesh(mesh)
     if args.distribution_strategy == "ParameterServerStrategy":
         from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
 

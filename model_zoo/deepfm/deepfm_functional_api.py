@@ -14,6 +14,8 @@ Parity: model_zoo/deepfm_functional_api in the reference (BASELINE config
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -62,6 +64,11 @@ class DeepFM(nn.Module):
     # 'xla' | 'fused' | 'auto' | None (process default) — threaded into
     # the Embedding layers (lookup/FM kernels) and the auto layout rule.
     sparse_kernel: str | None = None
+    # The job mesh (model_utils forwards it to mesh-aware models):
+    # under the fused kernel on a multi-device mesh the Embedding ops
+    # dispatch per-shard bodies through shard_map (tables over the
+    # `model` axis — ops/sparse_embedding.py "Sharded dispatch").
+    mesh: Any = None
 
     def _resolved_kernel(self) -> str:
         from elasticdl_tpu.ops import sparse_embedding as ske
@@ -98,12 +105,12 @@ class DeepFM(nn.Module):
             # passes (1.83M vs 3.25M at the 26M probe).
             linear = Embedding(
                 total_vocab, 1, name="linear_embedding",
-                sparse_kernel=self.sparse_kernel,
+                sparse_kernel=self.sparse_kernel, mesh=self.mesh,
             )(flat_ids)                                      # [B, 26, 1]
             first_cat = jnp.sum(linear[..., 0], axis=-1)     # [B]
             cat_emb = Embedding(
                 total_vocab, self.embedding_dim, name="fm_embedding",
-                sparse_kernel=self.sparse_kernel,
+                sparse_kernel=self.sparse_kernel, mesh=self.mesh,
             )(flat_ids)                                      # [B, 26, d]
             # FM second order: 0.5 * (sum^2 - sum-of-squares) over all
             # 39 fields at once.
@@ -128,6 +135,7 @@ class DeepFM(nn.Module):
             cat_acts, first_cat, sum_v, sum_sq = Embedding(
                 total_vocab, 1 + self.embedding_dim, name="fm_embedding",
                 sparse_kernel=self.sparse_kernel, fm_interaction=True,
+                mesh=self.mesh,
             )(flat_ids)                                      # [B, 26, 1+d]
             cat_emb = cat_acts[..., 1:]                      # [B, 26, d]
             fields = jnp.concatenate([cat_emb, dense_emb], axis=1)
@@ -154,6 +162,7 @@ def custom_model(
     split_tables: bool | None = None,
     sparse_apply_every: "int | str" = 1,
     sparse_kernel: "str | None" = None,
+    mesh: Any = None,
 ):
     """`sparse_apply_every` arrives from the job flag (model_utils
     forwards it to models declaring the parameter) and drives the auto
@@ -186,6 +195,7 @@ def custom_model(
         split_tables=split_tables,
         sparse_apply_every=sparse_apply_every,
         sparse_kernel=sparse_kernel,
+        mesh=mesh,
     )
 
 

@@ -28,8 +28,20 @@ Pallas interpret mode and asserts the fused results against the xla
 references — the `make test-sparse` gate that keeps this harness (and
 the kernels it measures) runnable without a chip.
 
+`--shard_map` (round 7) is the MULTI-DEVICE mode: tables shard their
+storage blocks over the mesh's `model` axis and every fused kernel
+dispatches per-shard bodies through shard_map
+(ops/sparse_embedding.py "Sharded dispatch").  It tables ns/row AND
+ns/row/shard (each shard owns 1/Nth of the touched rows — the number
+that must hold flat as the mesh grows for the fused path to survive
+scale-out).  `--shard_map --selftest` forces a 4-virtual-device CPU
+mesh and asserts the sharded routes against the single-device xla
+references in interpret mode — the `make test-compile` gate.
+
 Usage: python scripts/exp_sparse_gather.py [n_ids] [vocab_rows]
+       python scripts/exp_sparse_gather.py --shard_map [n_ids] [vocab]
        python scripts/exp_sparse_gather.py --selftest
+       python scripts/exp_sparse_gather.py --shard_map --selftest
 """
 
 from __future__ import annotations
@@ -222,6 +234,133 @@ def main(n_ids: int, vocab: int):
           f"{bw_floor_ms / n_ids * 1e6:6.1f} ns/row", flush=True)
 
 
+def _shard_mesh():
+    """(mesh, n_shards) over every visible device: data=1, model=N —
+    the fused multi-chip layout (tables block-shard over `model`)."""
+    import jax
+
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+
+    n = len(jax.devices())
+    return build_mesh(MeshConfig(data=1, model=n)), n
+
+
+def main_shard_map(n_ids: int, vocab: int):
+    """xla-vs-fused ns/row with the fused engines dispatched through
+    shard_map over a multi-device mesh.  The per-shard column divides by
+    the shard count: each model-axis shard owns 1/Nth of the touched
+    rows, so flat ns/row/shard across mesh sizes is the scale-out win
+    condition."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops import sparse_embedding as ske
+    from elasticdl_tpu.parallel import packed as pk
+    from elasticdl_tpu.parallel import sparse_optim
+    from elasticdl_tpu.parallel.packed import PackedSpec
+
+    mesh, n_shards = _shard_mesh()
+    spec = PackedSpec(vocab, 16)
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.rand(*spec.packed_shape).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, vocab, size=n_ids).astype(np.int32))
+    grads = jnp.asarray(rng.rand(n_ids, spec.dim).astype(np.float32))
+    print(
+        f"table {spec.packed_shape} sharded over {n_shards} model-axis "
+        f"shard(s), {n_ids} ids", flush=True,
+    )
+
+    def _row_per_shard(label, t):
+        _row(label, t, n_ids)
+        print(
+            f"{'':<20} {'':>10}  "
+            f"{t / (n_ids / n_shards) * 1e9:6.1f} ns/row/shard",
+            flush=True,
+        )
+
+    t = _time(
+        _loop(lambda i, tb, ix: jnp.sum(pk.lookup(spec, tb, ix + i))),
+        table, ids,
+    )
+    _row("pk.lookup (xla):", t, n_ids)
+    t = _time(
+        _loop(
+            lambda i, tb, ix: jnp.sum(
+                ske.fused_lookup(spec, tb, ix + i, mesh=mesh)
+            )
+        ),
+        table, ids,
+    )
+    _row_per_shard("fused_lookup (sm):", t)
+
+    opt_x = sparse_optim.adam(0.001, mode="scatter",
+                              bias_correction="global")
+    opt_f = sparse_optim.adam(0.001, mode="fused",
+                              bias_correction="global", mesh=mesh)
+    slots = opt_x.init_slots(spec, table)
+
+    def apply_body(opt):
+        def body(i, tb, sl, ix, g):
+            new_tb, _new_sl = opt.apply(spec, tb, sl, ix + i, g)
+            return jnp.sum(new_tb[0])
+
+        return body
+
+    t = _time(_loop(apply_body(opt_x)), table, slots, ids, grads)
+    _row("adam apply (xla):", t, n_ids)
+    t = _time(_loop(apply_body(opt_f)), table, slots, ids, grads)
+    _row_per_shard("adam apply (sm):", t)
+
+
+def selftest_shard_map() -> int:
+    """CPU interpret-mode gate of the SHARDED dispatch: on a forced
+    4-virtual-device mesh, the shard_map'd fused lookup is bit-exact vs
+    pk.lookup and the shard_map'd fused adam apply matches the xla
+    scatter path within the documented 1-ulp tolerance."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.ops import sparse_embedding as ske
+    from elasticdl_tpu.parallel import packed as pk
+    from elasticdl_tpu.parallel import sparse_optim
+    from elasticdl_tpu.parallel.mesh import MODEL_AXIS
+    from elasticdl_tpu.parallel.packed import PackedSpec
+
+    mesh, n_shards = _shard_mesh()
+    assert n_shards > 1, (
+        "shard_map selftest needs >1 device (forced virtual CPUs)"
+    )
+    rng = np.random.RandomState(0)
+    spec = PackedSpec(320, 16)
+    assert ske.table_partition_axis(spec.num_blocks, mesh) == MODEL_AXIS
+    table = jnp.asarray(rng.rand(*spec.packed_shape).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, 320, size=64).astype(np.int32))
+    grads = jnp.asarray(rng.rand(64, spec.dim).astype(np.float32))
+
+    ref = np.asarray(pk.lookup(spec, table, ids))
+    got = np.asarray(ske.fused_lookup(spec, table, ids, mesh=mesh))
+    assert np.array_equal(ref, got), "shard_map fused_lookup != pk.lookup"
+
+    opt_x = sparse_optim.adam(0.001, mode="scatter")
+    opt_f = sparse_optim.adam(0.001, mode="fused", mesh=mesh)
+    slots = opt_x.init_slots(spec, table)
+    tx, sx = opt_x.apply(spec, table, slots, ids, grads)
+    tf, sf = opt_f.apply(spec, table, slots, ids, grads)
+    np.testing.assert_allclose(
+        np.asarray(tf), np.asarray(tx), rtol=3e-7, atol=1e-7,
+        err_msg="shard_map fused adam table",
+    )
+    for key in sx:
+        np.testing.assert_allclose(
+            np.asarray(sf[key]), np.asarray(sx[key]), rtol=3e-7,
+            atol=1e-7, err_msg=f"shard_map fused adam slot {key}",
+        )
+    print(
+        f"exp_sparse_gather shard_map selftest OK ({n_shards}-shard "
+        "mesh: fused lookup bit-exact, fused adam apply within 1 ulp, "
+        "interpret mode)"
+    )
+    return 0
+
+
 def selftest() -> int:
     """CPU interpret-mode gate: every engine this harness measures runs
     and the fused results match the xla references (bit-exact for the
@@ -268,7 +407,22 @@ if __name__ == "__main__":
     parser.add_argument("n_ids", nargs="?", type=int, default=212_992)
     parser.add_argument("vocab", nargs="?", type=int, default=26_000_000)
     parser.add_argument("--selftest", action="store_true")
+    parser.add_argument(
+        "--shard_map", action="store_true",
+        help="multi-device mode: fused engines dispatched through "
+        "shard_map over a (1, n_devices) mesh (ns/row per shard)",
+    )
     args = parser.parse_args()
+    if args.shard_map and args.selftest:
+        # Force the virtual multi-device CPU world BEFORE jax's backend
+        # initializes (the selftest must run on a 1-device CI box).
+        from elasticdl_tpu.parallel.mesh import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(4)
+        sys.exit(selftest_shard_map())
     if args.selftest:
         sys.exit(selftest())
-    main(args.n_ids, args.vocab)
+    if args.shard_map:
+        main_shard_map(args.n_ids, args.vocab)
+    else:
+        main(args.n_ids, args.vocab)

@@ -66,9 +66,18 @@ EVENT_REQUIRED_FIELDS = {
     "bench_regress": ("verdict", "metrics_total", "regressed"),
     # Sparse-path engine decision (parallel/ps_trainer.py init): which
     # lookup/apply engine (xla vs the fused Pallas kernels) a training
-    # run's numbers were measured on — postmortems and bench audits
-    # must not have to guess (docs/design.md "Fused sparse kernels").
+    # run's numbers were measured on — and, for the fused engine, which
+    # dispatch route it took (`route`: single_device pallas_call vs
+    # shard_map over the mesh; 'xla' for the SPMD-partitioned engine) —
+    # postmortems and bench audits must not have to guess
+    # (docs/design.md "Fused sparse kernels").
     "sparse_kernel_selected": ("kernel",),
+    # Declarative compile layer (parallel/compile.py): one event per
+    # compiled entry point — trainer identity, pjit-vs-shard_map
+    # strategy, rule-table hit/miss counts, donated argnums — so a
+    # postmortem can always answer "what placement did this job
+    # actually compile?" (docs/design.md "Declarative sharding").
+    "compile_plan": ("trainer", "strategy"),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -230,8 +239,12 @@ def _selftest() -> int:
          "metrics_total": 8, "regressed": 1,
          "details": [{"metric": "deepfm", "ratio": 0.8}]},
         {"ts": 6.97, "event": "sparse_kernel_selected", "kernel": "fused",
-         "requested": "fused", "optimizer": "adam", "tables": 1,
-         "table_rows": 26000000},
+         "requested": "fused", "route": "shard_map", "optimizer": "adam",
+         "tables": 1, "table_rows": 26000000},
+        {"ts": 6.98, "event": "compile_plan", "trainer": "ps_trainer",
+         "name": "ps_train_step", "strategy": "pjit",
+         "rule_table": "ps-fused", "rule_hits": 3, "rule_misses": 0,
+         "donated_argnums": [0], "devices": 8},
         {"ts": 7.0, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
@@ -241,6 +254,7 @@ def _selftest() -> int:
         '{"ts": 1.35, "event": "profile_window", "worker_id": 1}',  # no action
         '{"ts": 1.4, "event": "bench_regress", "verdict": "ok"}',  # no counts
         '{"ts": 1.45, "event": "sparse_kernel_selected"}',  # no kernel
+        '{"ts": 1.47, "event": "compile_plan", "trainer": "dp"}',  # no strategy
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

@@ -1,0 +1,407 @@
+"""Declarative sharding compile layer (parallel/compile.py, ISSUE 10).
+
+Four gates:
+
+1. Rule-table semantics over REAL model pytrees (DeepFM / ResNet-50 /
+   transformer-LM param trees from jax.eval_shape): first-match wins,
+   unmatched non-scalar leaves are errors, scalars replicate without
+   consulting the table, regex order is precedence.
+2. Strategy selection (pjit-with-shardings vs shard_map for map-style
+   bodies) + the donation round-trip through `CompilePlan.compile`.
+3. Per-trainer HLO-structure parity on the 8-device dryrun mesh: the
+   compile-layer-built step compiles to the SAME collective structure
+   as the pre-port hand-rolled jax.jit/shard_map construction — the
+   refactor moved the plumbing, not the program.
+4. The grep gate: no direct jax.jit/pjit/shard_map construction left in
+   dp_trainer.py / ps_trainer.py / ring_attention.py — every compiled
+   entry point goes through parallel/compile.py.
+"""
+
+import re
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.parallel import MeshConfig, build_mesh, sparse_optim
+from elasticdl_tpu.parallel import compile as pc
+from elasticdl_tpu.parallel import sharding as shd
+from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
+from elasticdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+
+# ---------------------------------------------------------------------------
+# 1. Rule-table matching over the zoo pytrees
+# ---------------------------------------------------------------------------
+
+
+def _deepfm_params():
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    model = zoo.custom_model(vocab_size=50)
+    features = {
+        "dense": jax.ShapeDtypeStruct((4, zoo.NUM_DENSE), jnp.float32),
+        "cat": jax.ShapeDtypeStruct((4, zoo.NUM_CAT), jnp.int32),
+    }
+    variables = jax.eval_shape(model.init, jax.random.PRNGKey(0), features)
+    return variables["params"]
+
+
+def _resnet_params():
+    from model_zoo.resnet50 import resnet50_subclass as zoo
+
+    model = zoo.custom_model(use_bf16=False)
+    images = jax.ShapeDtypeStruct((2, 32, 32, 3), jnp.float32)
+    variables = jax.eval_shape(model.init, jax.random.PRNGKey(0), images)
+    return variables["params"]
+
+
+def _transformer_params():
+    from model_zoo.transformer import transformer_lm as lm
+
+    model = lm.custom_model(
+        vocab=64, d_model=16, num_heads=2, num_layers=1, max_len=32
+    )
+    tokens = jax.ShapeDtypeStruct((2, 16), jnp.int32)
+    variables = jax.eval_shape(model.init, jax.random.PRNGKey(0), tokens)
+    return variables["params"]
+
+
+def test_rule_table_matches_deepfm_embedding_by_regex():
+    params = _deepfm_params()
+    table = pc.RuleTable(
+        [
+            pc.Rule(r"embedding", P(MODEL_AXIS)),
+            pc.Rule(r".*", P()),
+        ],
+        name="test-deepfm",
+    )
+    specs, stats = table.match(params)
+    flat = dict(pc.tree_paths(specs))
+    emb = [k for k in flat if "embedding/embedding" in k]
+    assert emb, f"no embedding leaf found in {sorted(flat)[:5]}..."
+    for key in emb:
+        assert flat[key] == P(MODEL_AXIS), (key, flat[key])
+    # Dense leaves fell through to the catch-all.
+    dense = [k for k in flat if k.startswith("Dense")]
+    assert dense and all(flat[k] == P() for k in dense)
+    assert stats["rule_hits"] > 0 and stats["rule_misses"] == 0
+
+
+def test_rule_table_first_match_wins_and_order_is_precedence():
+    params = _transformer_params()
+    # A specific rule listed FIRST beats the later broad rule...
+    specific_first = pc.RuleTable([
+        pc.Rule(r"embed", P(MODEL_AXIS)),
+        pc.Rule(r".*", P()),
+    ]).match(params)[0]
+    # ...and the same specific rule listed AFTER a catch-all never fires.
+    broad_first = pc.RuleTable([
+        pc.Rule(r".*", P()),
+        pc.Rule(r"embed", P(MODEL_AXIS)),
+    ]).match(params)[0]
+    flat_sf = dict(pc.tree_paths(specific_first))
+    flat_bf = dict(pc.tree_paths(broad_first))
+    embed_keys = [k for k in flat_sf if "embed" in k.lower()]
+    assert embed_keys
+    assert any(flat_sf[k] == P(MODEL_AXIS) for k in embed_keys)
+    assert all(flat_bf[k] == P() for k in embed_keys)
+
+
+def test_rule_table_unmatched_leaf_is_an_error():
+    params = _resnet_params()
+    table = pc.RuleTable(
+        [pc.Rule(r"^this_matches_nothing$", P())], name="resnet-hole"
+    )
+    with pytest.raises(ValueError, match="no rule for leaf"):
+        table.match(params)
+
+
+def test_rule_table_scalars_replicate_without_consulting_rules():
+    tree = {"count": jnp.zeros((), jnp.int32), "w": jnp.zeros((8, 4))}
+    specs, stats = pc.RuleTable([pc.Rule(r"^w$", P(DATA_AXIS))]).match(tree)
+    assert specs["count"] == P()      # scalar: no rule needed
+    assert specs["w"] == P(DATA_AXIS)
+    assert stats["scalars"] == 1
+
+
+def test_rule_table_shape_aware_callable_rule():
+    def big_only(path, shape):
+        return P(DATA_AXIS) if int(np.prod(shape)) >= 64 else P()
+
+    tree = {"big": jnp.zeros((64, 4)), "small": jnp.zeros((2, 2))}
+    specs, _ = pc.RuleTable([pc.Rule(r".*", big_only)]).match(tree)
+    assert specs["big"] == P(DATA_AXIS) and specs["small"] == P()
+
+
+def test_match_partition_rules_functional_form():
+    specs = pc.match_partition_rules(
+        [pc.Rule(r".*", P())], {"a": jnp.zeros((4, 4))}
+    )
+    assert specs["a"] == P()
+
+
+# ---------------------------------------------------------------------------
+# 2. Strategy selection + donation round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_select_strategy():
+    assert pc.select_strategy(in_shardings=(P(),), out_shardings=P()) == "pjit"
+    assert pc.select_strategy() == "pjit"
+    assert pc.select_strategy(in_specs=(P(DATA_AXIS),),
+                              out_specs=P(DATA_AXIS)) == "shard_map"
+    with pytest.raises(ValueError, match="BOTH in_specs and out_specs"):
+        pc.select_strategy(in_specs=(P(DATA_AXIS),))
+
+
+def _journal_events(event):
+    from elasticdl_tpu import obs
+
+    return [e for e in obs.journal().tail(100) if e.get("event") == event]
+
+
+def test_compile_pjit_strategy_donation_round_trip_and_journal():
+    mesh = build_mesh(MeshConfig())
+    plan = pc.CompilePlan(
+        mesh,
+        pc.RuleTable([pc.Rule(r".*", P())], name="test-table"),
+        trainer="test_trainer",
+    )
+    repl = plan.replicated()
+    shardings = plan.state_shardings({"w": jnp.zeros((8, 8))})
+    step = plan.compile(
+        lambda state, x: (state + x, jnp.sum(x)),
+        name="test_step",
+        in_shardings=(shardings["w"], repl),
+        out_shardings=(shardings["w"], repl),
+        donate_argnums=(0,),
+    )
+    state = jax.device_put(jnp.ones((8, 8)), shardings["w"])
+    x = jax.device_put(jnp.ones((8, 8)), repl)
+    new_state, total = step(state, x)
+    np.testing.assert_allclose(np.asarray(new_state), 2.0)
+    assert float(total) == 64.0
+    assert state.is_deleted(), "donated input buffer survived the call"
+    events = _journal_events("compile_plan")
+    assert events, "compile() did not journal a compile_plan event"
+    last = events[-1]
+    assert last["trainer"] == "test_trainer"
+    assert last["strategy"] == "pjit"
+    assert last["name"] == "test_step"
+    assert last["rule_table"] == "test-table"
+    assert last["rule_hits"] == 1
+    assert last["donated_argnums"] == [0]
+
+
+def test_compile_shard_map_strategy_runs_map_style_body():
+    mesh = build_mesh(MeshConfig(data=8, model=1))
+    plan = pc.CompilePlan(mesh, trainer="test_trainer")
+
+    def body(x):
+        return x * jax.lax.psum(jnp.ones((), x.dtype), DATA_AXIS)
+
+    fn = plan.compile(
+        body,
+        name="test_map",
+        in_specs=(P(DATA_AXIS),),
+        out_specs=P(DATA_AXIS),
+    )
+    out = fn(jnp.ones((16, 4)))
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+    last = _journal_events("compile_plan")[-1]
+    assert last["strategy"] == "shard_map"
+
+
+# ---------------------------------------------------------------------------
+# 3. Per-trainer HLO-structure parity (compile layer vs hand-rolled)
+# ---------------------------------------------------------------------------
+
+COLLECTIVES = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+
+def _collective_signature(hlo_text):
+    """Sorted (opcode, result shapes) multiset — the structure that must
+    survive the port (instruction NAMES are arbitrary)."""
+    sigs = []
+    for op in COLLECTIVES:
+        pat = re.compile(rf"=\s*[^=]*\b{re.escape(op)}(-start)?\(")
+        for line in hlo_text.splitlines():
+            if pat.search(line):
+                shapes = tuple(
+                    re.findall(r"[a-z0-9]+\[[0-9,]*\]", line.split("=")[0])
+                )
+                sigs.append((op, shapes))
+    return sorted(sigs)
+
+
+class _DenseModel(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(nn.relu(nn.Dense(32)(x)))
+
+
+def _dense_loss(labels, outputs):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, labels.astype(jnp.int32)
+    ).mean()
+
+
+@pytest.mark.parametrize("dense_sharding", ["replicated", "fsdp"])
+def test_dp_trainer_hlo_parity_with_hand_rolled_step(dense_sharding):
+    mesh = build_mesh(MeshConfig(data=4, model=2))
+    trainer = DataParallelTrainer(
+        _DenseModel(), _dense_loss, optax.sgd(0.1), mesh,
+        dense_sharding=dense_sharding,
+    )
+    rng = np.random.RandomState(0)
+    features = rng.rand(16, 64).astype(np.float32)
+    labels = rng.randint(0, 4, size=16).astype(np.int32)
+    trainer.ensure_initialized(features)
+    staged = trainer.stage_batch(features, labels, np.ones((16,), np.float32))
+    ported = trainer._train_step.lower(
+        trainer.state, *staged
+    ).compile().as_text()
+
+    # The pre-port construction: a hand-rolled jax.jit with the same
+    # impl, shardings, and donation (what _compile_steps used to build).
+    state_sh = trainer._state_shardings(trainer.state)
+    batch = shd.batch_sharded(mesh)
+    repl = shd.replicated(mesh)
+    hand = jax.jit(
+        trainer._train_step_impl,
+        in_shardings=(state_sh, batch, batch, batch),
+        out_shardings=(state_sh, repl),
+        donate_argnums=(0,),
+    )
+    hand_rolled = hand.lower(trainer.state, *staged).compile().as_text()
+    assert _collective_signature(ported) == _collective_signature(
+        hand_rolled
+    )
+
+
+def test_ps_trainer_hlo_parity_with_hand_rolled_step():
+    from elasticdl_tpu.layers import Embedding
+
+    class _SparseModel(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            x = Embedding(2048, 8, combiner="sum", name="emb")(ids)
+            return nn.Dense(4, name="head")(x)
+
+    mesh = build_mesh(MeshConfig(data=4, model=2))
+    trainer = ShardedEmbeddingTrainer(
+        _SparseModel(), _dense_loss, optax.sgd(0.1), mesh,
+        embedding_optimizer=sparse_optim.adam(0.01),
+    )
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 2048, size=(16, 3)).astype(np.int32)
+    labels = rng.randint(0, 4, size=16).astype(np.int32)
+    trainer.ensure_initialized(ids)
+    staged = trainer.stage_batch(ids, labels, np.ones((16,), np.float32))
+    ported = trainer._train_step.lower(
+        trainer.state, *staged
+    ).compile().as_text()
+
+    state_sh = trainer._state_shardings(trainer.state)
+    batch = shd.batch_sharded(mesh)
+    repl = shd.replicated(mesh)
+    hand = jax.jit(
+        trainer._train_step_impl,
+        in_shardings=(state_sh, batch, batch, batch),
+        out_shardings=(state_sh, (repl, repl)),
+        donate_argnums=(0,),
+    )
+    hand_rolled = hand.lower(trainer.state, *staged).compile().as_text()
+    assert _collective_signature(ported) == _collective_signature(
+        hand_rolled
+    )
+    # The rule table reproduced the hand-rolled placement exactly: the
+    # table is sharded across the WHOLE mesh, like the old
+    # _table_sharding computed.
+    sh = state_sh.tables["emb/embedding"]
+    assert sh.spec == P((DATA_AXIS, MODEL_AXIS), None)
+
+
+def test_ring_attention_hlo_parity_with_hand_rolled_shard_map():
+    from functools import partial
+
+    from elasticdl_tpu.parallel import ring_attention as ra
+
+    mesh = build_mesh(MeshConfig(data=4, model=2))
+    rng = np.random.RandomState(2)
+    shape = (4, 16, 2, 8)  # [B, T, H, D]
+    q = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    spec = P(DATA_AXIS, MODEL_AXIS, None, None)
+    sharding = NamedSharding(mesh, spec)
+    q = jax.device_put(q, sharding)
+
+    ported_fn = ra.make_ring_attention(mesh, causal=True, impl="xla")
+    ported = jax.jit(ported_fn).lower(q, q, q).compile().as_text()
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    hand_fn = sm(
+        partial(
+            ra._ring_dispatch, axis_name=MODEL_AXIS, causal=True,
+            scale=None, layout="contiguous", impl="xla",
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    hand_rolled = jax.jit(hand_fn).lower(q, q, q).compile().as_text()
+    assert _collective_signature(ported) == _collective_signature(
+        hand_rolled
+    )
+    # And the ring really is a ppermute chain either way.
+    assert any(op == "collective-permute"
+               for op, _ in _collective_signature(ported))
+
+
+# ---------------------------------------------------------------------------
+# 4. Grep gate: the trainers compile ONLY through parallel/compile.py
+# ---------------------------------------------------------------------------
+
+_TRAINER_FILES = (
+    "elasticdl_tpu/parallel/dp_trainer.py",
+    "elasticdl_tpu/parallel/ps_trainer.py",
+    "elasticdl_tpu/parallel/ring_attention.py",
+)
+
+#: Direct compile-construction idioms the port removed.  `pc.` entry
+#: points (compile/ shard_map_call / jit_utility) are the sanctioned
+#: spellings.
+_DIRECT_COMPILE_RE = re.compile(
+    r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.shard_map\b|"
+    r"from\s+jax\.experimental\.shard_map\s+import"
+)
+
+
+@pytest.mark.parametrize("rel_path", _TRAINER_FILES)
+def test_no_direct_jit_or_shard_map_left_in_trainers(rel_path):
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, rel_path), "r", encoding="utf-8") as f:
+        text = f.read()
+    hits = [
+        (i + 1, line.strip())
+        for i, line in enumerate(text.splitlines())
+        if _DIRECT_COMPILE_RE.search(line.split("#", 1)[0])
+    ]
+    assert not hits, (
+        f"{rel_path} still hand-rolls compilation (use "
+        f"parallel/compile.py entry points): {hits}"
+    )
