@@ -101,15 +101,18 @@ test-sparse: sparse-gates
 # lockcheck concurrency, exporter endpoint round-trip, journal rotation,
 # the master end-to-end acceptance scrape, the worker telemetry plane
 # (heartbeat snapshots, straggler detection, trace correlation, obs.top),
-# and the goodput ledger/report plane — then the journal schema
-# validator's selftest + source-drift check and the postmortem report's
-# selftest over the golden journal fixture.
+# the goodput ledger/report plane, and the distributed tracing plane
+# (span trees, clock alignment, Perfetto export — tests/test_tracing.py
+# + the obs.trace selftest) — then the journal schema validator's
+# selftest + source-drift check and the postmortem report's selftest
+# over the golden journal fixture.
 test-obs:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
 	       tests/test_telemetry.py tests/test_goodput.py \
-	       tests/test_stepstats.py -q
+	       tests/test_stepstats.py tests/test_tracing.py -q
 	python scripts/validate_journal.py --selftest --check-sources
 	python scripts/validate_journal.py tests/golden_journal.jsonl
+	python -m elasticdl_tpu.obs.trace --selftest
 	JAX_PLATFORMS=cpu python -m elasticdl_tpu.obs.report \
 	       --selftest tests/golden_journal.jsonl
 	JAX_PLATFORMS=cpu python scripts/bench_regress.py --selftest

@@ -61,26 +61,50 @@ _RPC_GIVE_UPS = obs.counter(
 #: chain (docs/observability.md).  Lowercase per the gRPC metadata spec.
 TRACE_METADATA_KEY = "elasticdl-trace-id"
 
+#: Companion metadata key carrying the CALLER's open span id, so the
+#: receiving servicer's RPC-handler span nests under the client span in
+#: the assembled trace (obs/tracing.py; docs/observability.md
+#: "Distributed tracing").  Optional and independent of the trace id —
+#: old peers that only speak TRACE_METADATA_KEY remain wire-compatible.
+SPAN_METADATA_KEY = "elasticdl-span-id"
 
-def trace_metadata(trace_id: str) -> Optional[Tuple[Tuple[str, str], ...]]:
-    """Call-metadata tuple carrying `trace_id` (None when empty, so
-    callers can pass the result straight to `call_with_retry`)."""
-    if not trace_id:
-        return None
-    return ((TRACE_METADATA_KEY, str(trace_id)),)
+
+def trace_metadata(
+    trace_id: str, span_id: str = ""
+) -> Optional[Tuple[Tuple[str, str], ...]]:
+    """Call-metadata tuple carrying `trace_id` (and, when given, the
+    caller's `span_id` for cross-process span parenting).  None when
+    both are empty, so callers can pass the result straight to
+    `call_with_retry`."""
+    pairs = []
+    if trace_id:
+        pairs.append((TRACE_METADATA_KEY, str(trace_id)))
+    if span_id:
+        pairs.append((SPAN_METADATA_KEY, str(span_id)))
+    return tuple(pairs) or None
 
 
-def trace_id_from_context(context) -> str:
-    """Extract the trace id from a servicer context's invocation
-    metadata ('' when absent — old workers, non-task RPCs)."""
+def _metadata_value(context, wanted_key: str) -> str:
     try:
         metadata = context.invocation_metadata()
     except Exception:
         return ""
     for key, value in metadata or ():
-        if key == TRACE_METADATA_KEY:
+        if key == wanted_key:
             return value
     return ""
+
+
+def trace_id_from_context(context) -> str:
+    """Extract the trace id from a servicer context's invocation
+    metadata ('' when absent — old workers, non-task RPCs)."""
+    return _metadata_value(context, TRACE_METADATA_KEY)
+
+
+def span_id_from_context(context) -> str:
+    """The caller's span id ('' when absent) — the parent for this
+    handler's RPC span."""
+    return _metadata_value(context, SPAN_METADATA_KEY)
 
 
 _CHANNEL_OPTIONS = [

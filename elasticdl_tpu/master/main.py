@@ -238,6 +238,13 @@ def build_master(args, model_spec=None, rendezvous_server=None) -> Master:
 
 
 def start_master(args, model_spec=None, rendezvous_server=None) -> Master:
+    # Tracing plane identity + crash flight recorder: master spans label
+    # as `master` on the assembled trace, and a SIGTERM'd/exiting master
+    # flushes its open spans + a final registry snapshot to the journal.
+    from elasticdl_tpu.obs import tracing
+
+    tracing.set_process("master")
+    tracing.install_flight_recorder()
     master = build_master(args, model_spec, rendezvous_server)
     master.server, master.port = start_master_server(
         master.servicer, port=args.master_port
@@ -257,6 +264,15 @@ def start_master(args, model_spec=None, rendezvous_server=None) -> Master:
                 "Metrics exporter could not bind port %d; continuing "
                 "without /metrics", metrics_port,
             )
+        if master.metrics_exporter is not None:
+            # Discovery file next to the journal: `--metrics_port 0`
+            # binds an ephemeral port, and scrapers/tests read the
+            # chosen one from here instead of hardcoding it.
+            port_dir = getattr(args, "tensorboard_log_dir", "") or getattr(
+                args, "checkpoint_dir", ""
+            )
+            if port_dir:
+                master.metrics_exporter.write_port_file(port_dir)
     obs.journal().record(
         "master_start",
         job_name=args.job_name,

@@ -216,6 +216,7 @@ class ElasticRendezvous:
         a rank never counts as a heartbeat and the startup grace for
         never-heartbeated workers stays intact."""
         formed_id = None
+        formation_span = None
         with self._lock:
             self._record_host_locked(worker_id, host)
             self._resolve_coordinator_locked()
@@ -226,8 +227,19 @@ class ElasticRendezvous:
                 if self._ranks_polled >= set(ids):
                     self._formation_observed = True
                     formed_id = self._rendezvous_id
-                    self._m_formation.observe(
+                    formation_s = (
                         time.monotonic() - self._world_declared_monotonic
+                    )
+                    self._m_formation.observe(formation_s)
+                    # Trace span for the formation window (declaration ->
+                    # every member knows its rank): wall-clock start from
+                    # the declaration stamp, monotonic duration — emitted
+                    # outside the lock below.
+                    formation_span = dict(
+                        start_ts=self._world_declared_at,
+                        duration_s=formation_s,
+                        rendezvous_id=formed_id,
+                        world_size=len(ids),
                     )
             response = pb.GetCommRankResponse(
                 rank_id=rank,
@@ -240,6 +252,12 @@ class ElasticRendezvous:
             # Every member knows its rank: the rendezvous component of
             # any in-flight rescale ends here (outside the lock).
             goodput.ledger().on_world_formed(formed_id)
+        if formation_span is not None:
+            from elasticdl_tpu.obs import tracing
+
+            tracing.tracer().record_span(
+                "rendezvous.formation", **formation_span
+            )
         return response
 
     def report_liveness(self, worker_id: int, host: str, rendezvous_id: int) -> bool:

@@ -13,10 +13,15 @@ so the task-lifecycle journal chain spans the process boundary.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
-from elasticdl_tpu.common.grpc_utils import trace_id_from_context
+from elasticdl_tpu.common.grpc_utils import (
+    span_id_from_context,
+    trace_id_from_context,
+)
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.obs import tracing
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.proto.service import MasterServicer as _Base
 
@@ -49,18 +54,48 @@ class MasterServicer(_Base):
     # ------------------------------------------------------------------
 
     def get_task(self, request, context):
+        # The master half of dispatch as a trace span: timed around the
+        # dispatcher, journaled after the fact (the trace id only exists
+        # once get() mints it), parented under the worker's client span
+        # when its id arrived as call metadata.  WAIT/complete answers
+        # carry no trace and journal no span.
+        start_ts = time.time()
+        start = time.monotonic()
         task = self._task_manager.get(request.worker_id)
+        if task.trace_id:
+            tracing.tracer().record_span(
+                "rpc.get_task",
+                start_ts=start_ts,
+                duration_s=time.monotonic() - start,
+                trace_id=task.trace_id,
+                parent_id=span_id_from_context(context) or task.trace_id,
+                worker_id=request.worker_id,
+                task_id=task.task_id,
+            )
         return pb.GetTaskResponse(task=task)
 
     def report_task_result(self, request, context):
         success = not request.err_message
+        trace_id = trace_id_from_context(context)
+        start_ts = time.time()
+        start = time.monotonic()
         self._task_manager.report(
             request.task_id,
             success,
             worker_id=request.worker_id,
             exec_counters=dict(request.exec_counters),
-            trace_id=trace_id_from_context(context),
+            trace_id=trace_id,
         )
+        if trace_id:
+            tracing.tracer().record_span(
+                "rpc.report_task_result",
+                start_ts=start_ts,
+                duration_s=time.monotonic() - start,
+                trace_id=trace_id,
+                parent_id=span_id_from_context(context) or trace_id,
+                worker_id=request.worker_id,
+                task_id=request.task_id,
+            )
         if not success:
             logger.warning(
                 "Worker %d failed task %d: %s",

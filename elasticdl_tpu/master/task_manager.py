@@ -26,7 +26,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
-from elasticdl_tpu.obs import goodput
+from elasticdl_tpu.obs import goodput, tracing
 from elasticdl_tpu.common.constants import TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -187,9 +187,16 @@ class TaskManager:
         self._doing: Dict[int, Tuple[int, _Task, float, str]] = {}  # guarded-by: _lock
         self._task_id = 0  # guarded-by: _lock
         # Trace-id prefix: distinguishes dispatches across master restarts
-        # (pid) AND across manager instances within one process (seq) —
-        # task ids restart at 1 in both cases — without wall-clock input.
-        self._trace_prefix = f"{os.getpid():x}.{next(_MANAGER_SEQ)}"
+        # AND across manager instances within one process (seq) — task ids
+        # restart at 1 in both cases — without wall-clock input.  The pid
+        # alone cannot discriminate restarts on the k8s substrate (every
+        # master pod's main process is PID 1, and colliding trace ids
+        # would cross-link two generations' span trees in the assembled
+        # trace), so a random salt rides along; identity, not schedule —
+        # the determinism-replay rule is untouched.
+        self._trace_prefix = (
+            f"{os.getpid():x}{os.urandom(3).hex()}.{next(_MANAGER_SEQ)}"
+        )
         self._epoch = 0  # guarded-by: _lock
         self._finished_record_count = 0  # guarded-by: _lock
         self._recovered_record_count = 0  # guarded-by: _lock
@@ -284,9 +291,13 @@ class TaskManager:
         fired_done = False
         done_callbacks = []
         journal_events: List[dict] = []
+        expired_spans: List[dict] = []
         try:
             with self._lock:
-                journal_events.extend(self._recover_timed_out_locked())
+                expired_events, expired_spans = (
+                    self._recover_timed_out_locked()
+                )
+                journal_events.extend(expired_events)
                 if not self._todo and not self._doing:
                     # Current epoch fully finished: advance or end.
                     if self._epoch + 1 < self._num_epochs and self._training_shards:
@@ -339,6 +350,10 @@ class TaskManager:
             # must never extend control-plane lock holds).
             for event in journal_events:
                 obs.journal().record(**event)
+            # Timed-out attempts close their trace's root span (same
+            # emit path as every other task.lifetime — one wire format).
+            for span in expired_spans:
+                tracing.tracer().record_span(**span)
             # Goodput ledger hooks (also outside the lock — they journal):
             # a dispatch opens the work phase; timeout requeues add to the
             # redo debt the ledger charges against goodput.
@@ -390,6 +405,21 @@ class TaskManager:
             type_name = _TaskManagerMetrics.task_type_name(task.type)
             duration_s = time.time() - _start
             self._metrics.duration.observe(duration_s, type=type_name)
+            # Root span of the trace: the dispatch->report lifetime of
+            # this attempt.  span_id == trace_id (the cross-process
+            # parenting convention — every other process parents under
+            # the root knowing only the trace id); emitted outside the
+            # lock below, after the outcome branch stamps any error.
+            root_span = dict(
+                name="task.lifetime",
+                start_ts=_start,
+                duration_s=duration_s,
+                trace_id=stored_trace,
+                root=True,
+                task_id=task_id,
+                worker_id=worker_id,
+                type=type_name,
+            )
             eval_done_cbs = []
             if success:
                 self._metrics.completed.inc(type=type_name)
@@ -445,6 +475,7 @@ class TaskManager:
                     self._max_task_retries,
                 )
                 self._metrics.failed_permanently.inc()
+                root_span["error"] = "failed_permanently"
                 journal_events.append(
                     dict(
                         event="task_failed_permanently",
@@ -464,6 +495,7 @@ class TaskManager:
                     task_id, task.retry_count, self._max_task_retries,
                 )
                 self._metrics.requeues.inc(reason="failure")
+                root_span["error"] = "failure"
                 journal_events.append(
                     dict(
                         event="task_requeue",
@@ -489,6 +521,8 @@ class TaskManager:
                     callbacks_to_run = list(self._tasks_done_callbacks)
         for event in journal_events:
             obs.journal().record(**event)
+        if stored_trace:
+            tracing.tracer().record_span(**root_span)
         # Goodput accounting (outside the lock): completed training
         # records repay any redo debt; failure requeues add to it.
         training = task.type == pb.TRAINING
@@ -535,9 +569,12 @@ class TaskManager:
             ]
             trace_ids = []
             churn_records = 0
+            churn_spans = []
+            now = time.time()
             for tid in recovered:
                 _owner, task, _start, trace_id = self._doing.pop(tid)
                 trace_ids.append(trace_id)
+                churn_spans.append((tid, trace_id, _start, now - _start))
                 self._todo.appendleft(task)
                 if task.type == pb.TRAINING:
                     self._recovered_record_count += task.end - task.start
@@ -557,16 +594,31 @@ class TaskManager:
                 task_ids=recovered,
                 trace_ids=trace_ids,
             )
+            # Close each recovered trace's root span (error=worker_churn)
+            # so the assembled view shows the attempt's full extent.
+            for tid, trace_id, started, elapsed in churn_spans:
+                if trace_id:
+                    tracing.tracer().record_span(
+                        "task.lifetime",
+                        start_ts=started,
+                        duration_s=elapsed,
+                        trace_id=trace_id,
+                        root=True,
+                        task_id=tid,
+                        worker_id=worker_id,
+                        error="worker_churn",
+                    )
             goodput.ledger().note_requeue(
                 churn_records, "worker_churn", tasks=len(recovered)
             )
         return len(recovered)
 
-    def _recover_timed_out_locked(self) -> List[dict]:
-        """Returns the journal events for expired tasks; the caller emits
-        them once the dispatch lock is released."""
+    def _recover_timed_out_locked(self) -> Tuple[List[dict], List[dict]]:
+        """Returns (journal events, task.lifetime root-span kwargs) for
+        expired tasks; the caller emits both once the dispatch lock is
+        released (spans via tracing.record_span — one wire format)."""
         if not self._task_timeout_s:
-            return []
+            return [], []
         now = time.time()
         expired = [
             tid
@@ -574,12 +626,28 @@ class TaskManager:
             if now - start > self._task_timeout_s
         ]
         events = []
+        spans = []
         for tid in expired:
             owner, task, _start, trace_id = self._doing.pop(tid)
             self._todo.appendleft(task)
             if task.type == pb.TRAINING:
                 self._recovered_record_count += task.end - task.start
             self._metrics.requeues.inc(reason="timeout")
+            # Close the trace's root span too: a timed-out attempt must
+            # not leave its trace rootless in the assembled view.
+            if trace_id:
+                spans.append(
+                    dict(
+                        name="task.lifetime",
+                        start_ts=_start,
+                        duration_s=now - _start,
+                        trace_id=trace_id,
+                        root=True,
+                        task_id=tid,
+                        worker_id=owner,
+                        error="timeout",
+                    )
+                )
             events.append(
                 dict(
                     event="task_requeue",
@@ -598,7 +666,7 @@ class TaskManager:
                 )
             )
             logger.info("Task %d timed out on worker %d; requeued", tid, owner)
-        return events
+        return events, spans
 
     # ------------------------------------------------------------------
     # Introspection / lifecycle
@@ -765,10 +833,20 @@ class TaskProgressPersister:
         return self
 
     def stop(self):
+        self.cancel()
+        self.persist_now()
+
+    def cancel(self):
+        """Stop the loop WITHOUT the final persist — for harnesses that
+        simulate a hard-killed master (the snapshot must stay as-crashed)
+        while still reaping the thread: a leaked 2s persister loop keeps
+        mutating the checkpoint metrics for the rest of the process,
+        which is exactly the cross-test flake the exact-delta obs
+        assertions tripped on.  stop() is cancel() + the final persist —
+        one copy of the shutdown protocol."""
         self._stop_event.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
-        self.persist_now()
 
     def persist_now(self):
         import os

@@ -149,27 +149,29 @@ def _span_metric_name(name: str) -> str:
 def span(name: str, labels=None, **fields):
     """Timer emitting BOTH halves of the observability plane: a histogram
     observation (`elasticdl_span_<name>_seconds`, bounded `labels` only)
-    and a journal record (`fields` may carry unbounded ids — task_id,
-    pod name — which never touch metric labels)."""
+    and — via the tracing plane (obs/tracing.py) — a journal `span`
+    record carrying span/trace ids and parent context, so every obs.span
+    call site is automatically a node in the distributed trace.  `fields`
+    may carry unbounded ids (task_id, trace_id, pod name) — they ride the
+    journal, never metric labels.  Yields the open tracing Span (callers
+    propagate `span_id` over RPC metadata)."""
+    from elasticdl_tpu.obs import tracing
+
     labels = dict(labels or {})
     hist = _registry.histogram(
         _span_metric_name(name),
         f"Duration of {name} spans",
         labelnames=tuple(sorted(labels)),
     )
+    trace_id = fields.pop("trace_id", "")
+    # Merge (fields win) rather than double-splat: a key present in both
+    # must overwrite, not TypeError a worker's task loop.
+    merged = {**labels, **fields}
     start = time.monotonic()
-    error = None
     try:
-        yield
-    except BaseException as exc:
-        error = type(exc).__name__
-        raise
+        with tracing.tracer().span(
+            name, trace_id=trace_id, **merged
+        ) as open_span:
+            yield open_span
     finally:
-        duration_s = time.monotonic() - start
-        hist.observe(duration_s, **labels)
-        record = {"name": name, "duration_s": round(duration_s, 6)}
-        if error is not None:
-            record["error"] = error
-        record.update(labels)
-        record.update(fields)
-        _journal.record("span", **record)
+        hist.observe(time.monotonic() - start, **labels)

@@ -33,6 +33,12 @@ logger = get_logger("obs.exporter")
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+#: Discovery file written next to the journal: `--metrics_port 0` binds
+#: an ephemeral port, and scrapers/tests read the chosen port from here
+#: instead of hardcoding one (the master e2e suites' port-collision
+#: flake source).
+PORT_FILENAME = "metrics_port"
+
 
 class _ExporterHTTPServer(ThreadingMixIn, HTTPServer):
     daemon_threads = True
@@ -112,6 +118,52 @@ class MetricsExporter:
             "(/metrics, /healthz, /debug/vars)", self._port,
         )
         return self
+
+    def write_port_file(self, directory: str) -> Optional[str]:
+        """Write the BOUND port to `<directory>/metrics_port` (atomic
+        tmp+rename — a reader never sees a torn write).  Returns the
+        path, or None when the write failed / the exporter has not
+        started; never raises — discovery is observability, not control
+        plane."""
+        import os
+        import tempfile
+
+        if not self._port or not directory:
+            return None
+        path = os.path.join(directory, PORT_FILENAME)
+        tmp_path = None
+        try:
+            fd, tmp_path = tempfile.mkstemp(
+                prefix=PORT_FILENAME + ".", dir=directory
+            )
+            with os.fdopen(fd, "w") as f:
+                f.write(f"{self._port}\n")
+            os.replace(tmp_path, path)
+        except OSError:
+            logger.exception(
+                "Could not write metrics-port discovery file in %s",
+                directory,
+            )
+            if tmp_path is not None:
+                try:
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
+            return None
+        logger.info("Metrics port %d recorded in %s", self._port, path)
+        return path
+
+    @staticmethod
+    def read_port_file(directory: str) -> Optional[int]:
+        """The discovered port (None when absent/garbled) — what tests
+        and scrape tooling call instead of hardcoding a port."""
+        import os
+
+        try:
+            with open(os.path.join(directory, PORT_FILENAME)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
 
     def stop(self):
         if self._server is None:

@@ -162,6 +162,7 @@ def summarize(events: List[dict]) -> dict:
     ]
     summaries = [e for e in events if e.get("event") == "goodput_summary"]
     compute = _compute_attribution(events)
+    task_chains = _slowest_task_chains(events)
     # Independent cross-check channel: the seconds each phase_transition
     # CARRIED (the emitting ledger's own accounting), as opposed to the
     # timestamp-derived segment durations above.  Derived time per phase
@@ -201,6 +202,8 @@ def summarize(events: List[dict]) -> dict:
         "end_ts": events[-1]["ts"],
         **compute,
     }
+    if task_chains:
+        summary["task_chains"] = task_chains
     if summaries:
         final = summaries[-1]
         summary["ledger_summary"] = {
@@ -211,6 +214,59 @@ def summarize(events: List[dict]) -> dict:
             )
         }
     return summary
+
+
+#: Rows in the "slowest task chains" table.
+TOP_TASK_CHAINS = 10
+
+
+def _slowest_task_chains(
+    events: List[dict], top: int = TOP_TASK_CHAINS
+) -> List[dict]:
+    """Top-N end-to-end task latencies from the tracing plane's
+    ``task.lifetime`` root spans (obs/tracing.py: the master journals
+    one per closed dispatch, dispatch -> report/requeue), with the
+    worker-side execute share joined from the same trace's
+    ``worker.task`` span when the worker journal is merged in."""
+    roots: List[dict] = []
+    worker_spans: Dict[str, float] = {}
+    for event in events:
+        if event.get("event") != "span":
+            continue
+        duration = event.get("duration_s")
+        if not isinstance(duration, (int, float)) or isinstance(
+            duration, bool
+        ) or duration < 0:
+            continue
+        trace_id = event.get("trace_id")
+        if event.get("name") == "task.lifetime":
+            roots.append(event)
+        elif event.get("name") == "worker.task" and trace_id:
+            worker_spans[trace_id] = max(
+                worker_spans.get(trace_id, 0.0), float(duration)
+            )
+    roots.sort(key=lambda e: -float(e["duration_s"]))
+    chains = []
+    for event in roots[:top]:
+        chain = {
+            key: event.get(key)
+            for key in (
+                "trace_id", "task_id", "worker_id", "type", "error",
+            )
+            if event.get(key) is not None
+        }
+        chain["duration_s"] = round(float(event["duration_s"]), 6)
+        trace_id = event.get("trace_id")
+        if trace_id in worker_spans:
+            chain["worker_s"] = round(worker_spans[trace_id], 6)
+            # The chain's non-worker share: RPC hops + queue/dispatch
+            # overhead (clock skew can push it below zero pre-alignment;
+            # floor at 0 — obs.trace is the precision tool).
+            chain["overhead_s"] = round(
+                max(0.0, chain["duration_s"] - chain["worker_s"]), 6
+            )
+        chains.append(chain)
+    return chains
 
 
 def _compute_attribution(events: List[dict]) -> dict:
@@ -390,6 +446,30 @@ def render_report(summary: dict, max_segments: int = 80) -> str:
                 f"steps [{window.get('step_start')}, "
                 f"{window.get('step_end')}) -> {window.get('trace_dir')}"
             )
+    task_chains = summary.get("task_chains")
+    if task_chains:
+        lines.append("")
+        lines.append(
+            "slowest task chains (dispatch -> report, from task.lifetime "
+            "spans; `python -m elasticdl_tpu.obs.trace` for the aligned "
+            "waterfall):"
+        )
+        for chain in task_chains:
+            extra = ""
+            if chain.get("worker_s") is not None:
+                extra = (
+                    f"  worker {_fmt_duration(chain['worker_s'])} + "
+                    f"overhead {_fmt_duration(chain['overhead_s'])}"
+                )
+            if chain.get("error"):
+                extra += f"  [{chain['error']}]"
+            lines.append(
+                f"  {_fmt_duration(chain['duration_s']):>8}  "
+                f"task {chain.get('task_id')} "
+                f"(worker {chain.get('worker_id')}, "
+                f"{chain.get('type', '?')}, "
+                f"trace {chain.get('trace_id')}){extra}"
+            )
     if summary["rescales"]:
         lines.append("")
         lines.append("rescales:")
@@ -521,6 +601,19 @@ def selftest(path: str) -> int:
                     f"worker {wid} phase fractions sum to "
                     f"{worker_sum:.4f}, not ~1.0"
                 )
+    for chain in summary.get("task_chains", ()):
+        if chain["duration_s"] < 0:
+            problems.append(
+                f"task chain {chain.get('trace_id')} has negative "
+                f"duration {chain['duration_s']}"
+            )
+        if chain.get("worker_s") is not None and (
+            chain["worker_s"] < 0 or chain["overhead_s"] < 0
+        ):
+            problems.append(
+                f"task chain {chain.get('trace_id')} has negative "
+                "worker/overhead split"
+            )
     for r in summary["rescales"]:
         parts = sum(
             r.get(k) or 0.0 for k in ("detection_s", "rendezvous_s", "redo_s")

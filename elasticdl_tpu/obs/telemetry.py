@@ -155,6 +155,10 @@ class WorkerTelemetry:
         self._task_records_done = 0  # guarded-by: _lock
         self._retry_stats = None  # guarded-by: _lock
         self._anatomy = None  # guarded-by: _lock
+        #: Wall-clock stamp of the newest snapshot — the clock-probe
+        #: pairing key (see snapshot()).  Written/read on the heartbeat
+        #: thread only.
+        self.last_snapshot_ts: float = 0.0
 
     @property
     def worker_id(self) -> int:
@@ -211,10 +215,18 @@ class WorkerTelemetry:
             steps = sorted(self._step_times)
             retry_stats = self._retry_stats
             anatomy = self._anatomy
+            # Remembered for the clock-probe pairing key: the heartbeat
+            # journals a `clock_probe` carrying THIS stamp, and the
+            # master's worker_telemetry event forwards the same value as
+            # `worker_ts` — the trace assembler joins the two to turn
+            # heartbeat round-trips into clock-offset estimates
+            # (obs/trace.py; docs/observability.md "Distributed
+            # tracing").
+            self.last_snapshot_ts = round(time.time(), 3)
             snap = {
                 "v": SNAPSHOT_VERSION,
                 "worker_id": self._worker_id,
-                "ts": round(time.time(), 3),
+                "ts": self.last_snapshot_ts,
                 "rendezvous_id": self._rendezvous_id,
                 "steps_total": self._steps_total,
                 "records_total": self._records_total,

@@ -218,10 +218,31 @@ class HeartbeatReporter:
                     payload = ""  # telemetry must never kill the liveness plane
             try:
                 if payload:
+                    # Clock probe around the carrying RPC: the send/recv
+                    # wall stamps journal (this process's journal) as a
+                    # `clock_probe`, paired by the trace assembler with
+                    # the master's worker_telemetry event (same
+                    # worker_ts) to estimate this worker's clock offset
+                    # by the midpoint method — the heartbeat doubles as
+                    # the time-sync plane with zero new RPCs.
+                    t_send = time.time()
                     self._mc.report_worker_liveness(
                         self._host, self._world.rendezvous_id,
                         telemetry_json=payload,
                     )
+                    t_recv = time.time()
+                    probe_ts = getattr(
+                        self._telemetry, "last_snapshot_ts", 0.0
+                    )
+                    if probe_ts:
+                        obs.journal().record(
+                            "clock_probe",
+                            worker_id=self._mc.worker_id,
+                            probe_ts=probe_ts,
+                            t_send=round(t_send, 6),
+                            t_recv=round(t_recv, 6),
+                            rtt_s=round(t_recv - t_send, 6),
+                        )
                 else:
                     self._mc.report_worker_liveness(
                         self._host, self._world.rendezvous_id

@@ -31,7 +31,7 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
 from elasticdl_tpu.data.columnar import materialize_columnar_task
 from elasticdl_tpu.data.dataset import Dataset, SequentialRecords, _stack
-from elasticdl_tpu.obs import goodput
+from elasticdl_tpu.obs import goodput, tracing
 from elasticdl_tpu.parallel import elastic
 from elasticdl_tpu.parallel import sharding as shd
 from elasticdl_tpu.parallel.dp_trainer import DataParallelTrainer
@@ -162,8 +162,13 @@ class CollectiveWorker:
             return
         # Goodput: restore time is its own phase (this process's ledger)
         # — after a re-formation it is part of what the rescale costs.
+        # The tracing span gives the same window a node on the assembled
+        # timeline (rank-scoped; no task trace yet at boot).
         with goodput.ledger().phase("checkpoint_restore", cause="boot"):
-            self._restore_from_checkpoint_inner()
+            with tracing.span(
+                "checkpoint.restore", rank=self._world.rank
+            ):
+                self._restore_from_checkpoint_inner()
 
     def _restore_from_checkpoint_inner(self):
         if self._sharded_ckpt:
@@ -575,8 +580,12 @@ class CollectiveWorker:
                 self._maybe_checkpoint()
             if self._anatomy is not None:
                 # One anatomy window per dispatch flush: the unit the
-                # heartbeat snapshot summarizes.
-                self._anatomy.close_window()
+                # heartbeat snapshot summarizes — and one aggregate
+                # child span per phase under the open worker.task span
+                # (docs/observability.md "Distributed tracing").
+                window = self._anatomy.close_window()
+                if window:
+                    tracing.tracer().record_window_spans(window)
 
         batches = self._local_batches(task, Mode.TRAINING)
         while True:
@@ -746,13 +755,18 @@ class CollectiveWorker:
         )
         if due and step > 0 and step != self._last_ckpt_step:
             # Goodput: the save window (including the host gather every
-            # rank joins) is checkpoint_save, not training.
+            # rank joins) is checkpoint_save, not training.  The tracing
+            # span nests under worker.task when the save fired from a
+            # mid-task cadence check (root-less at job end).
             with goodput.ledger().phase("checkpoint_save", cause="cadence"):
-                if self._sharded_ckpt:
-                    # Collective: every rank writes its own shard rows.
-                    self._trainer.save_checkpoint(self._ckpt, step)
-                else:
-                    host_state = self._trainer.state_to_host()
-                    if self._world.is_leader:
-                        self._ckpt.save(host_state, step)
+                with tracing.span(
+                    "checkpoint.save", rank=self._world.rank, step=step
+                ):
+                    if self._sharded_ckpt:
+                        # Collective: every rank writes its own shards.
+                        self._trainer.save_checkpoint(self._ckpt, step)
+                    else:
+                        host_state = self._trainer.state_to_host()
+                        if self._world.is_leader:
+                            self._ckpt.save(host_state, step)
             self._last_ckpt_step = step

@@ -53,6 +53,16 @@ def main(argv=None):
             faults.ENV_VAR, os.environ.get(faults.ENV_VAR),
         )
     args = parse_worker_args(argv)
+    # Tracing plane identity + crash flight recorder: this worker's
+    # spans label as `worker_<id>` on the assembled trace
+    # (obs/trace.py), and process exit — including SIGTERM via the
+    # SystemExit conversion above — flushes open spans + a final
+    # registry snapshot, so a preempted worker leaves a complete trace
+    # tail instead of a cliff.
+    from elasticdl_tpu.obs import tracing
+
+    tracing.set_process(f"worker_{args.worker_id}")
+    tracing.install_flight_recorder()
     if getattr(args, "tensorboard_log_dir", ""):
         # Each process owns its journal (obs scoping rule): give worker
         # processes a durable file so worker-side events — profile_window

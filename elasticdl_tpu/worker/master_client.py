@@ -104,8 +104,38 @@ class MasterClient:
     # ------------------------------------------------------------------
 
     def get_task(self, task_type: int = pb.TRAINING) -> pb.Task:
+        """The client half of dispatch is a trace span: the span id is
+        minted BEFORE the call and rides gRPC metadata (the servicer's
+        `rpc.get_task` span parents under it), and the span journals
+        after the fact once the response reveals the trace id — WAIT
+        polls and job-complete answers carry no trace and journal no
+        span (a poll loop must not flood the journal)."""
+        from elasticdl_tpu.obs import tracing
+
         request = pb.GetTaskRequest(worker_id=self._worker_id, task_type=task_type)
-        return self._call_idempotent("get_task", request).task
+        span_id = tracing.tracer().mint_span_id()
+        start_ts = time.time()
+        start = time.monotonic()
+        task = self._call(
+            "get_task",
+            request,
+            self._retry_policy,
+            metadata=trace_metadata("", span_id=span_id),
+        ).task
+        if task.trace_id:
+            tracing.tracer().record_span(
+                "worker.get_task",
+                start_ts=start_ts,
+                duration_s=time.monotonic() - start,
+                trace_id=task.trace_id,
+                # Root convention: the task root's span id IS the trace
+                # id, so the client can parent under it without ever
+                # having seen the root span.
+                parent_id=task.trace_id,
+                span_id=span_id,
+                worker_id=self._worker_id,
+            )
+        return task
 
     def report_task_result(
         self, task_id: int, err_message: str = "",
@@ -114,15 +144,33 @@ class MasterClient:
         """`trace_id` (the dispatch-minted id from Task.trace_id) rides
         gRPC metadata back to the master, closing the cross-process
         journal chain (grpc_utils.TRACE_METADATA_KEY)."""
+        from elasticdl_tpu.obs import tracing
+
         request = pb.ReportTaskResultRequest(
             task_id=task_id, err_message=err_message, worker_id=self._worker_id
         )
         if exec_counters:
             for key, value in exec_counters.items():
                 request.exec_counters[key] = int(value)
-        self._call_once(
-            "report_task_result", request, metadata=trace_metadata(trace_id)
-        )
+        if not trace_id:
+            self._call_once("report_task_result", request)
+            return
+        # Traced report: the client span parents under the task root
+        # (the worker.task span has already closed by report time), and
+        # its span id rides the metadata so the master's
+        # rpc.report_task_result handler span nests under it.
+        with tracing.tracer().span(
+            "worker.report_task",
+            trace_id=trace_id,
+            parent_id=trace_id,
+            worker_id=self._worker_id,
+            task_id=task_id,
+        ) as report_span:
+            self._call_once(
+                "report_task_result",
+                request,
+                metadata=trace_metadata(trace_id, span_id=report_span.span_id),
+            )
 
     def report_task_result_best_effort(
         self, task_id: int, err_message: str = "",

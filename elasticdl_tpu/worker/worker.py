@@ -222,10 +222,14 @@ class Worker:
             # path has no telemetry heartbeat to carry it, journal the
             # cumulative anatomy here (the process journal: shared with
             # the master in-process in Local mode, the worker's own
-            # events_worker_N.jsonl in subprocess runs).
-            from elasticdl_tpu.obs import stepstats
+            # events_worker_N.jsonl in subprocess runs).  The window's
+            # phases also become aggregate child spans of the open
+            # worker.task span (obs/tracing.py).
+            from elasticdl_tpu.obs import stepstats, tracing
 
-            self._anatomy.close_window()
+            window = self._anatomy.close_window()
+            if window:
+                tracing.tracer().record_window_spans(window)
             stepstats.journal_anatomy(
                 self._anatomy.worker_id, self._anatomy.snapshot()
             )

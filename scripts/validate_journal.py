@@ -78,6 +78,19 @@ EVENT_REQUIRED_FIELDS = {
     # postmortem can always answer "what placement did this job
     # actually compile?" (docs/design.md "Declarative sharding").
     "compile_plan": ("trainer", "strategy"),
+    # Distributed tracing plane (obs/tracing.py + obs/trace.py —
+    # docs/observability.md "Distributed tracing").  `span` above stays
+    # backward-compatible (name + duration_s); tracing-plane spans add
+    # span_id/trace_id/parent_span_id/start_ts as optional fields.
+    # `clock_probe` is the worker-journal half of clock alignment:
+    # wall stamps around the telemetry-carrying heartbeat RPC, paired
+    # with the master's worker_telemetry event by (worker_id, probe_ts
+    # == worker_ts) for the midpoint offset estimate.
+    "clock_probe": ("worker_id", "probe_ts", "t_send", "t_recv"),
+    # Crash flight recorder (tracing.flush_flight_record): the final
+    # bounded metrics dump a SIGTERM'd process leaves next to its
+    # flushed open spans.
+    "registry_snapshot": ("reason",),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -245,7 +258,21 @@ def _selftest() -> int:
          "name": "ps_train_step", "strategy": "pjit",
          "rule_table": "ps-fused", "rule_hits": 3, "rule_misses": 0,
          "donated_argnums": [0], "devices": 8},
-        {"ts": 7.0, "event": "some_future_event", "anything": "goes"},
+        # Tracing-plane span: the legacy envelope (name + duration_s)
+        # plus the span-tree fields the assembler keys on.
+        {"ts": 7.02, "event": "span", "name": "task.lifetime",
+         "duration_s": 9.01, "start_ts": 6.99, "span_id": "t-1-1",
+         "trace_id": "t-1-1", "proc": "master", "task_id": 1},
+        {"ts": 7.04, "event": "span", "name": "step.data_wait",
+         "duration_s": 2.0, "start_ts": 7.0, "span_id": "s-abc-3",
+         "parent_span_id": "s-abc-2", "trace_id": "t-1-1",
+         "proc": "worker_0"},
+        {"ts": 7.06, "event": "clock_probe", "worker_id": 0,
+         "probe_ts": 7.001, "t_send": 7.001, "t_recv": 7.041,
+         "rtt_s": 0.04},
+        {"ts": 7.08, "event": "registry_snapshot", "reason": "shutdown",
+         "proc": "worker_0", "metrics": {"elasticdl_rpc_calls_total": 5}},
+        {"ts": 7.1, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
         '{"ts": 1.0, "event": "task_requeue"}',        # missing reason
@@ -255,6 +282,8 @@ def _selftest() -> int:
         '{"ts": 1.4, "event": "bench_regress", "verdict": "ok"}',  # no counts
         '{"ts": 1.45, "event": "sparse_kernel_selected"}',  # no kernel
         '{"ts": 1.47, "event": "compile_plan", "trainer": "dp"}',  # no strategy
+        '{"ts": 1.48, "event": "clock_probe", "worker_id": 0}',  # no stamps
+        '{"ts": 1.49, "event": "registry_snapshot"}',           # no reason
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

@@ -41,7 +41,7 @@ def main(argv):
     # ledger seeds from the predecessor's phase accounting the same way
     # a real replacement master does (master/main.build_master).
     from elasticdl_tpu import obs
-    from elasticdl_tpu.obs import goodput
+    from elasticdl_tpu.obs import goodput, tracing
     from elasticdl_tpu.obs.journal import DEFAULT_FILENAME
 
     predecessor_journal = os.path.exists(
@@ -50,6 +50,11 @@ def main(argv):
     journal_path = obs.init_journal(ckpt_dir)
     if predecessor_journal:
         goodput.ledger().seed_from_journal(journal_path)
+    # Tracing identity + flight recorder (same wiring as the real
+    # master entrypoint): spans label `master`, and even this driver's
+    # exit flushes any open span tail.
+    tracing.set_process("master")
+    tracing.install_flight_recorder()
 
     resumed = False
     resumed_finished = 0
@@ -85,6 +90,14 @@ def main(argv):
     if bound != port:
         print(f"could not bind port {port}", file=sys.stderr)
         return 3
+
+    # Observability surface on an EPHEMERAL port, discovered via the
+    # port file next to the journal — the chaos test must never race
+    # another suite for a hardcoded metrics port.
+    from elasticdl_tpu.obs.exporter import MetricsExporter
+
+    exporter = MetricsExporter(port=0).start()
+    exporter.write_port_file(ckpt_dir)
 
     persister = TaskProgressPersister(
         task_manager, ckpt_dir, interval_s=0.1

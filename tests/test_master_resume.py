@@ -57,7 +57,11 @@ def test_master_killed_midepoch_resumes(tmp_path):
     _drain(client, trained, stop_after=5)
     master.progress_persister.persist_now()
     client.close()
-    master.server.stop(grace=None)  # hard kill: no persister.stop()
+    master.server.stop(grace=None)  # hard kill: no final persist
+    # ...but reap the persister thread: leaked, its 2s loop would keep
+    # bumping the task_progress save histogram under later tests'
+    # exact-delta asserts (a real cross-suite flake).
+    master.progress_persister.cancel()
 
     progress_path = TaskProgressPersister.progress_path(args.checkpoint_dir)
     assert os.path.exists(progress_path)

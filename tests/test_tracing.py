@@ -1,0 +1,706 @@
+"""Distributed tracing plane tests (obs/tracing.py + obs/trace.py).
+
+Covers:
+
+- Tracer semantics: contextvar parent/child nesting, trace-id
+  inheritance, the root convention (root span_id == trace_id), error
+  stamping, thread isolation, after-the-fact ``record_span``, and the
+  aggregate phase-window child spans;
+- ``obs.span`` integration: every existing span call site now journals
+  span/trace ids while keeping its histogram half;
+- the crash flight recorder: open spans flush with duration-so-far and
+  a final ``registry_snapshot`` lands in the journal;
+- clock-offset estimation: midpoint recovery from heartbeat
+  round-trips, median robustness, the master-authoritative one-way
+  fallback below 2 round-trips, and zero-signal behavior;
+- monotonic clamping: no negative durations or child-escaping-parent
+  spans survive assembly, including through clamped ancestors;
+- golden journals -> Chrome trace-event JSON that schema-validates
+  (stdlib validator), with per-process rows and lane-packed tids;
+- ``--metrics_port 0`` discovery: the exporter writes the bound port
+  next to the journal and readers find it without hardcoding;
+- obs.report's "slowest task chains" table from task.lifetime spans;
+- the ISSUE acceptance e2e: a real master + 3 gRPC workers produce,
+  via the assembler, a schema-valid Chrome trace reconstructing a full
+  dispatch -> RPC -> execute -> report chain with zero negative or
+  child-escaping spans.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.obs import tracing
+from elasticdl_tpu.obs.journal import EventJournal
+from elasticdl_tpu.obs import trace as trace_mod
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+
+def _spans(journal):
+    return [e for e in journal.tail(500) if e.get("event") == "span"]
+
+
+# ---------------------------------------------------------------------------
+# Tracer semantics
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_nesting_inherits_trace_and_parent():
+    journal = EventJournal()
+    tracer = tracing.Tracer(journal=journal, proc="testproc")
+    with tracer.span("outer", trace_id="t-1") as outer:
+        assert tracer.current() is outer
+        with tracer.span("inner") as inner:
+            assert inner.trace_id == "t-1"
+            assert inner.parent_span_id == outer.span_id
+    assert tracer.current() is None
+    records = _spans(journal)
+    assert [r["name"] for r in records] == ["inner", "outer"]
+    inner_rec, outer_rec = records
+    assert inner_rec["parent_span_id"] == outer_rec["span_id"]
+    assert inner_rec["trace_id"] == outer_rec["trace_id"] == "t-1"
+    assert outer_rec["proc"] == "testproc"
+    for rec in records:
+        assert rec["duration_s"] >= 0
+        assert rec["start_ts"] > 0
+        assert rec["span_id"]
+
+
+def test_root_convention_span_id_is_trace_id():
+    journal = EventJournal()
+    tracer = tracing.Tracer(journal=journal)
+    with tracer.span("task.lifetime", trace_id="t-9", root=True) as root:
+        assert root.span_id == "t-9"
+    rec = tracer.record_span(
+        "task.lifetime", start_ts=100.0, duration_s=2.5,
+        trace_id="t-10", root=True, task_id=7,
+    )
+    assert rec["span_id"] == "t-10"
+    assert rec["start_ts"] == 100.0
+    assert rec["duration_s"] == 2.5
+    assert rec["task_id"] == 7
+
+
+def test_span_error_stamped_on_exception():
+    journal = EventJournal()
+    tracer = tracing.Tracer(journal=journal)
+    try:
+        with tracer.span("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    (rec,) = _spans(journal)
+    assert rec["error"] == "ValueError"
+    assert tracer.open_spans() == {}
+
+
+def test_thread_contexts_do_not_cross_parent():
+    journal = EventJournal()
+    tracer = tracing.Tracer(journal=journal)
+    seen = {}
+    barrier = threading.Barrier(2)
+
+    def run(tag):
+        with tracer.span(f"outer_{tag}") as outer:
+            barrier.wait(timeout=10)
+            with tracer.span(f"inner_{tag}") as inner:
+                seen[tag] = (outer.span_id, inner.parent_span_id)
+            barrier.wait(timeout=10)
+
+    threads = [
+        threading.Thread(target=run, args=(tag,), daemon=True)
+        for tag in ("a", "b")
+    ]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=30)
+    assert seen["a"][1] == seen["a"][0]
+    assert seen["b"][1] == seen["b"][0]
+    assert seen["a"][0] != seen["b"][0]
+
+
+def test_record_window_spans_sequential_under_current():
+    journal = EventJournal()
+    tracer = tracing.Tracer(journal=journal)
+    window = {"steps": 8, "data_wait": 1.0, "execute": 3.0, "bookkeep": 0.5}
+    # No-op outside a span: phase detail has no tree to hang from.
+    assert tracer.record_window_spans(window, end_ts=100.0) == 0
+    with tracer.span("worker.task", trace_id="t-1") as task_span:
+        emitted = tracer.record_window_spans(window, end_ts=100.0)
+    assert emitted == 3
+    phases = [r for r in _spans(journal) if r["name"].startswith("step.")]
+    assert [r["name"] for r in phases] == [
+        "step.data_wait", "step.execute", "step.bookkeep",
+    ]
+    # Sequential, exclusive, ending at end_ts; all children of the task.
+    assert phases[0]["start_ts"] == 95.5
+    assert phases[1]["start_ts"] == 96.5
+    assert phases[2]["start_ts"] == 99.5
+    for rec in phases:
+        assert rec["parent_span_id"] == task_span.span_id
+        assert rec["trace_id"] == "t-1"
+
+
+def test_obs_span_integration_journals_ids_and_observes_histogram(
+    obs_registry_snapshot,
+):
+    test_start = time.time() - 1
+    with obs.span(
+        "worker.task", labels={"type": "TRAINING"},
+        task_id=3, trace_id="t-int-1",
+    ) as span:
+        assert span.trace_id == "t-int-1"
+    rec = next(
+        e for e in reversed(obs.journal().tail(200))
+        if e.get("event") == "span" and e.get("trace_id") == "t-int-1"
+    )
+    assert rec["name"] == "worker.task"
+    assert rec["span_id"] and rec["start_ts"] >= test_start
+    assert rec["task_id"] == 3 and rec["type"] == "TRAINING"
+    hist = obs.registry().get("elasticdl_span_worker_task_seconds")
+    assert hist is not None
+
+
+def test_flight_recorder_flushes_open_spans_and_registry(
+    obs_registry_snapshot,
+):
+    test_start = time.time() - 1
+    tracer = tracing.tracer()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        with tracer.span("worker.task", trace_id="t-fr-1"):
+            entered.set()
+            release.wait(timeout=30)
+
+    thread = threading.Thread(target=hold, daemon=True)
+    thread.start()
+    assert entered.wait(timeout=10)
+    try:
+        flushed = tracing.flush_flight_record("test_shutdown")
+        assert flushed >= 1
+        tail = obs.journal().tail(200)
+        span_rec = next(
+            e for e in reversed(tail)
+            if e.get("event") == "span" and e.get("trace_id") == "t-fr-1"
+        )
+        assert span_rec["flushed"] == "test_shutdown"
+        assert span_rec["duration_s"] >= 0
+        snap = next(
+            e for e in reversed(tail)
+            if e.get("event") == "registry_snapshot"
+            and e["ts"] >= test_start
+        )
+        assert snap["reason"] == "test_shutdown"
+        assert "metrics" in snap or "families" in snap
+    finally:
+        release.set()
+        thread.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Clock-offset estimation
+# ---------------------------------------------------------------------------
+
+
+def _probe(wid, stamp, skew, rtt=0.04):
+    """A worker clock_probe + the matching master worker_telemetry pair
+    for a worker whose clock runs `skew` seconds ahead of the master's
+    (symmetric legs: the master stamp lands mid-window)."""
+    stamp = round(stamp, 3)
+    probe = {
+        "ts": stamp + rtt, "event": "clock_probe", "worker_id": wid,
+        "probe_ts": stamp, "t_send": stamp, "t_recv": stamp + rtt,
+    }
+    telemetry = {
+        "ts": stamp - skew + rtt / 2, "event": "worker_telemetry",
+        "worker_id": wid, "worker_ts": stamp,
+    }
+    return probe, telemetry
+
+
+def test_offset_midpoint_recovers_symmetric_skew():
+    probes, telemetry = [], []
+    for k in range(4):
+        p, t = _probe(0, 1000.0 + k, skew=25.0)
+        probes.append(p)
+        telemetry.append(t)
+    offset, method, pairs = trace_mod.estimate_offset(probes, telemetry)
+    assert method == "midpoint" and pairs == 4
+    assert abs(offset - (-25.0)) < 1e-6
+
+
+def test_offset_median_shrugs_off_outlier_probe():
+    probes, telemetry = [], []
+    for k in range(5):
+        p, t = _probe(0, 1000.0 + k, skew=-10.0)
+        probes.append(p)
+        telemetry.append(t)
+    # One probe with a wildly delayed return leg (asymmetric rtt).
+    probes[2]["t_recv"] = probes[2]["t_send"] + 30.0
+    offset, method, _pairs = trace_mod.estimate_offset(probes, telemetry)
+    assert method == "midpoint"
+    assert abs(offset - 10.0) < 0.05
+
+
+def test_offset_master_authoritative_fallback_below_two_roundtrips():
+    probe, telemetry = _probe(0, 1000.0, skew=5.0, rtt=0.02)
+    offset, method, pairs = trace_mod.estimate_offset([probe], [telemetry])
+    # One matched pair: fall back to the one-way ingest delta — the
+    # master-authoritative estimate (offset plus the one-way delay).
+    assert method == "one_way" and pairs == 1
+    assert abs(offset - (-5.0 + 0.01)) < 1e-6
+    offset, method, pairs = trace_mod.estimate_offset([], [])
+    assert (offset, method, pairs) == (0.0, "none", 0)
+
+
+def test_estimate_offsets_per_worker_sources():
+    master = []
+    workers = {}
+    for wid, skew in ((0, 12.0), (1, -3.0)):
+        events = []
+        for k in range(3):
+            p, t = _probe(wid, 2000.0 + k, skew=skew)
+            events.append(p)
+            master.append(t)
+        workers[f"worker_{wid}"] = events
+    offsets = trace_mod.estimate_offsets(
+        {"master": master, **workers}
+    )
+    assert offsets["master"]["method"] == "authoritative"
+    assert abs(offsets["worker_0"]["offset_s"] + 12.0) < 1e-6
+    assert abs(offsets["worker_1"]["offset_s"] - 3.0) < 1e-6
+    assert offsets["worker_0"]["method"] == "midpoint"
+
+
+# ---------------------------------------------------------------------------
+# Clamping
+# ---------------------------------------------------------------------------
+
+
+def _span(span_id, start, end, parent="", name="s", proc="p"):
+    return {
+        "name": name, "trace_id": "t", "span_id": span_id,
+        "parent_span_id": parent, "start": start, "end": end,
+        "proc": proc, "args": {},
+    }
+
+
+def test_clamp_fixes_negative_and_escaping_spans():
+    spans = [
+        _span("root", 0.0, 10.0),
+        _span("early", -1.0, 4.0, parent="root"),       # starts early
+        _span("late", 8.0, 12.0, parent="root"),        # ends late
+        _span("negative", 5.0, 3.0, parent="root"),     # negative length
+        _span("fine", 2.0, 6.0, parent="root"),
+    ]
+    assert trace_mod.check_invariants(spans) != []
+    adjusted = trace_mod.clamp_spans(spans)
+    assert adjusted == 3
+    assert trace_mod.check_invariants(spans) == []
+    by_id = {s["span_id"]: s for s in spans}
+    assert by_id["early"]["start"] == 0.0
+    assert by_id["late"]["end"] == 10.0
+    assert by_id["negative"]["end"] == by_id["negative"]["start"]
+    assert "clamped" not in by_id["fine"]
+
+
+def test_clamp_cascades_through_clamped_ancestors():
+    spans = [
+        _span("root", 0.0, 10.0),
+        _span("mid", 7.0, 14.0, parent="root"),   # clamps to [7, 10]
+        _span("leaf", 11.0, 13.0, parent="mid"),  # must land inside [7, 10]
+    ]
+    trace_mod.clamp_spans(spans)
+    assert trace_mod.check_invariants(spans) == []
+    leaf = next(s for s in spans if s["span_id"] == "leaf")
+    assert 7.0 <= leaf["start"] <= leaf["end"] <= 10.0
+
+
+# ---------------------------------------------------------------------------
+# Golden journals -> Chrome trace
+# ---------------------------------------------------------------------------
+
+
+def _write_jsonl(path, events):
+    with open(path, "w", encoding="utf-8") as f:
+        for event in events:
+            f.write(json.dumps(event) + "\n")
+
+
+def _golden_journals(tmp_path, skew=40.0):
+    """A master + one skewed worker journal with a full task chain."""
+    t0 = 1_754_000_000.0
+    trace_id = "t-g.0-1"
+    master = [
+        {"ts": t0, "event": "master_start", "job_name": "golden"},
+        {"ts": t0 + 0.02, "event": "task_dispatch", "task_id": 1,
+         "worker_id": 0, "trace_id": trace_id},
+        {"ts": t0 + 0.02, "event": "span", "name": "rpc.get_task",
+         "start_ts": t0 + 0.01, "duration_s": 0.008, "span_id": "m1",
+         "parent_span_id": "w1", "trace_id": trace_id, "proc": "master"},
+        {"ts": t0 + 5.02, "event": "span",
+         "name": "rpc.report_task_result", "start_ts": t0 + 5.0,
+         "duration_s": 0.01, "span_id": "m2", "parent_span_id": "w9",
+         "trace_id": trace_id, "proc": "master"},
+        {"ts": t0 + 5.03, "event": "span", "name": "task.lifetime",
+         "start_ts": t0 + 0.01, "duration_s": 5.01, "span_id": trace_id,
+         "trace_id": trace_id, "proc": "master", "task_id": 1},
+        {"ts": t0 + 6.0, "event": "phase_transition", "from": "training",
+         "to": "idle", "seconds": 5.5, "cause": "wait"},
+    ]
+    worker = []
+    for k in range(3):
+        stamp = round(t0 + skew + 0.5 + k, 3)
+        worker.append(
+            {"ts": stamp + 0.04, "event": "clock_probe", "worker_id": 0,
+             "probe_ts": stamp, "t_send": stamp, "t_recv": stamp + 0.04}
+        )
+        master.append(
+            {"ts": stamp - skew + 0.02, "event": "worker_telemetry",
+             "worker_id": 0, "worker_ts": stamp}
+        )
+    base = t0 + skew
+    worker.extend([
+        {"ts": base + 0.02, "event": "span", "name": "worker.get_task",
+         "start_ts": base + 0.008, "duration_s": 0.011, "span_id": "w1",
+         "parent_span_id": trace_id, "trace_id": trace_id,
+         "proc": "worker_0"},
+        {"ts": base + 4.9, "event": "span", "name": "worker.task",
+         "start_ts": base + 0.02, "duration_s": 4.88, "span_id": "w2",
+         "parent_span_id": trace_id, "trace_id": trace_id,
+         "proc": "worker_0"},
+        {"ts": base + 4.9, "event": "span", "name": "step.data_wait",
+         "start_ts": base + 0.03, "duration_s": 1.2, "span_id": "w3",
+         "parent_span_id": "w2", "trace_id": trace_id, "proc": "worker_0"},
+        {"ts": base + 4.9, "event": "span", "name": "step.execute",
+         "start_ts": base + 1.23, "duration_s": 3.6, "span_id": "w4",
+         "parent_span_id": "w2", "trace_id": trace_id, "proc": "worker_0"},
+        {"ts": base + 5.02, "event": "span", "name": "worker.report_task",
+         "start_ts": base + 4.99, "duration_s": 0.02, "span_id": "w9",
+         "parent_span_id": trace_id, "trace_id": trace_id,
+         "proc": "worker_0"},
+    ])
+    master.sort(key=lambda e: e["ts"])
+    _write_jsonl(os.path.join(str(tmp_path), "events.jsonl"), master)
+    _write_jsonl(
+        os.path.join(str(tmp_path), "events_worker_0.jsonl"), worker
+    )
+    return trace_id
+
+
+def test_golden_journals_assemble_to_schema_valid_chrome_trace(tmp_path):
+    trace_id = _golden_journals(tmp_path, skew=40.0)
+    result = trace_mod.assemble([str(tmp_path)])
+    # Offset recovered (midpoint over 3 probes), worker events aligned.
+    info = result["offsets"]["worker_0"]
+    assert info["method"] == "midpoint" and info["pairs"] == 3
+    assert abs(info["offset_s"] + 40.0) < 0.021
+    assert result["invariant_problems"] == []
+    # Chain: every hop nests (after alignment) inside the root.
+    by_id = {s["span_id"]: s for s in result["spans"]}
+    root = by_id[trace_id]
+    for span_id in ("w1", "m1", "w2", "w3", "w4", "w9", "m2"):
+        span = by_id[span_id]
+        assert root["start"] - 1e-9 <= span["start"], span_id
+        assert span["end"] <= root["end"] + 1e-9, span_id
+    # The worker.task interior aligned into the master's 5s window, not
+    # 40 seconds away.
+    assert abs(by_id["w2"]["start"] - (root["start"] + 0.01)) < 0.1
+    # Chrome export schema-validates; both processes named.
+    chrome = result["chrome"]
+    assert trace_mod.validate_chrome_trace(chrome) == []
+    names = {
+        e["args"]["name"]
+        for e in chrome["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {"master", "worker_0"} <= names
+    cats = {e.get("cat") for e in chrome["traceEvents"] if e["ph"] == "X"}
+    assert "span" in cats and "goodput_phase" in cats
+    # Text waterfall renders the chain.
+    text = trace_mod.render_waterfall(result["spans"])
+    assert "task.lifetime" in text and "step.execute" in text
+
+
+def test_trace_cli_writes_json_and_waterfall(tmp_path):
+    _golden_journals(tmp_path, skew=-7.0)
+    out = os.path.join(str(tmp_path), "trace.json")
+    rc = trace_mod.main([str(tmp_path), "-o", out])
+    assert rc == 0
+    with open(out) as f:
+        chrome = json.load(f)
+    assert trace_mod.validate_chrome_trace(chrome) == []
+    assert chrome["otherData"]["clock_offsets"]["worker_0"]["method"] == (
+        "midpoint"
+    )
+    rc = trace_mod.main([str(tmp_path)])  # text fallback path
+    assert rc == 0
+
+
+def test_trace_selftest_subprocess():
+    completed = subprocess.run(
+        [sys.executable, "-m", "elasticdl_tpu.obs.trace", "--selftest"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert completed.returncode == 0, completed.stderr
+    assert "trace selftest OK" in completed.stdout
+
+
+# ---------------------------------------------------------------------------
+# Satellites: metrics-port discovery + report task-chain table
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_port_discovery_file(tmp_path, obs_registry_snapshot):
+    from elasticdl_tpu.obs.exporter import MetricsExporter
+
+    exporter = MetricsExporter(port=0).start()
+    try:
+        path = exporter.write_port_file(str(tmp_path))
+        assert path and os.path.exists(path)
+        port = MetricsExporter.read_port_file(str(tmp_path))
+        assert port == exporter.port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as response:
+            assert response.status == 200
+    finally:
+        exporter.stop()
+    assert MetricsExporter.read_port_file(str(tmp_path / "nope")) is None
+
+
+def test_report_slowest_task_chains_table():
+    from elasticdl_tpu.obs import report
+
+    t0 = 1_754_000_000.0
+    events = [
+        {"ts": t0, "event": "master_start", "job_name": "j"},
+        {"ts": t0 + 9, "event": "span", "name": "task.lifetime",
+         "start_ts": t0, "duration_s": 9.0, "span_id": "t-1",
+         "trace_id": "t-1", "task_id": 1, "worker_id": 0,
+         "type": "TRAINING"},
+        {"ts": t0 + 8.5, "event": "span", "name": "worker.task",
+         "start_ts": t0 + 0.1, "duration_s": 8.2, "span_id": "w",
+         "trace_id": "t-1"},
+        {"ts": t0 + 3, "event": "span", "name": "task.lifetime",
+         "start_ts": t0, "duration_s": 3.0, "span_id": "t-2",
+         "trace_id": "t-2", "task_id": 2, "worker_id": 1,
+         "type": "TRAINING", "error": "timeout"},
+        {"ts": t0 + 10, "event": "phase_transition", "from": "training",
+         "to": "idle", "seconds": 10.0},
+    ]
+    summary = report.summarize(events)
+    chains = summary["task_chains"]
+    assert [c["trace_id"] for c in chains] == ["t-1", "t-2"]
+    assert chains[0]["duration_s"] == 9.0
+    assert chains[0]["worker_s"] == 8.2
+    assert abs(chains[0]["overhead_s"] - 0.8) < 1e-9
+    assert chains[1]["error"] == "timeout"
+    text = report.render_report(summary)
+    assert "slowest task chains" in text
+    assert "trace t-1" in text
+
+
+# ---------------------------------------------------------------------------
+# Acceptance e2e: real master + 3 gRPC workers -> assembled trace
+# ---------------------------------------------------------------------------
+
+
+def test_trace_end_to_end_master_and_three_workers(
+    tmp_path, obs_registry_snapshot
+):
+    """ISSUE acceptance: a real master + 3 gRPC workers run produces,
+    via the assembler, a schema-valid Chrome trace that reconstructs a
+    completed task's dispatch -> RPC -> execute -> report chain across
+    the gRPC boundary with zero negative-duration or
+    child-escaping-parent spans."""
+    from elasticdl_tpu.common.constants import TaskExecCounterKey
+    from elasticdl_tpu.common.grpc_utils import RetryPolicy
+    from elasticdl_tpu.master.rendezvous_server import ElasticRendezvous
+    from elasticdl_tpu.master.servicer import (
+        MasterServicer,
+        start_master_server,
+    )
+    from elasticdl_tpu.master.task_manager import TaskManager
+    from elasticdl_tpu.obs.exporter import MetricsExporter
+    from elasticdl_tpu.obs.telemetry import (
+        TelemetryAggregator,
+        WorkerTelemetry,
+    )
+    from elasticdl_tpu.parallel.elastic import HeartbeatReporter, WorldInfo
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.worker.master_client import MasterClient
+
+    journal = obs.journal()
+    previous_path = journal.path
+    journal_path = obs.init_journal(str(tmp_path))
+    task_manager = TaskManager(
+        training_shards={"shard": 96}, records_per_task=32
+    )
+    rendezvous = ElasticRendezvous(coordinator_port_fn=lambda host: 23457)
+    rendezvous.set_worker_hosts(
+        [(0, "127.0.0.1"), (1, "127.0.0.1"), (2, "127.0.0.1")]
+    )
+    aggregator = TelemetryAggregator(
+        current_workers_fn=lambda: [w for w, _h in rendezvous.world()],
+        journal_interval_s=0.0,  # every ingest journals: probe pairs
+    )
+    servicer = MasterServicer(
+        task_manager=task_manager,
+        rendezvous_server=rendezvous,
+        telemetry=aggregator,
+    )
+    server, port = start_master_server(servicer, port=0)
+    exporter = MetricsExporter(port=0).start()
+    assert exporter.write_port_file(str(tmp_path))
+    policy = RetryPolicy(
+        timeout_s=5.0, max_attempts=3, base_backoff_s=0.01,
+        max_backoff_s=0.05, jitter=0.0, total_budget_s=30.0,
+        wait_for_ready=True,
+    )
+    clients = [
+        MasterClient(f"localhost:{port}", worker_id=wid, retry_policy=policy)
+        for wid in range(3)
+    ]
+    telemetries = {
+        wid: WorkerTelemetry(wid, step_window=4) for wid in range(3)
+    }
+    reporters = [
+        HeartbeatReporter(
+            clients[wid],
+            WorldInfo(rank=wid, world_size=3, rendezvous_id=1,
+                      coordinator_addr=""),
+            host="127.0.0.1",
+            interval_s=0.05,
+            telemetry=telemetries[wid],
+        )
+        for wid in range(3)
+    ]
+    completed_traces = []
+    errors = []
+
+    def worker_loop(wid):
+        client = clients[wid]
+        try:
+            while True:
+                task = client.get_task()
+                if task.task_id == -1 and task.type != pb.WAIT:
+                    return
+                if task.type == pb.WAIT:
+                    time.sleep(0.02)
+                    continue
+                with obs.span(
+                    "worker.task",
+                    labels={"type": pb.TaskType.Name(task.type)},
+                    task_id=task.task_id,
+                    trace_id=task.trace_id,
+                    worker_id=wid,
+                ):
+                    telemetries[wid].record_steps(
+                        2, duration_s=0.02, records=task.end - task.start
+                    )
+                    # The step-anatomy window this "training" produced,
+                    # as aggregate phase child spans.
+                    tracing.tracer().record_window_spans(
+                        {"steps": 2, "data_wait": 0.004, "execute": 0.016}
+                    )
+                client.report_task_result(
+                    task.task_id, "",
+                    exec_counters={
+                        TaskExecCounterKey.BATCH_COUNT: 2,
+                        TaskExecCounterKey.RECORD_COUNT: (
+                            task.end - task.start
+                        ),
+                    },
+                    trace_id=task.trace_id,
+                )
+                completed_traces.append(task.trace_id)
+        except Exception as exc:  # surfaces in the main thread's assert
+            errors.append((wid, exc))
+
+    threads = [
+        threading.Thread(target=worker_loop, args=(wid,), daemon=True)
+        for wid in range(3)
+    ]
+    try:
+        for reporter in reporters:
+            reporter.start()
+        # Let a few heartbeats land first so clock probes exist.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            probes = [
+                e for e in journal.tail(500)
+                if e.get("event") == "clock_probe"
+            ]
+            if len(probes) >= 6:
+                break
+            time.sleep(0.02)
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=60)
+        assert errors == [], errors
+        assert completed_traces
+    finally:
+        for reporter in reporters:
+            reporter.stop()
+        exporter.stop()
+        for client in clients:
+            client.close()
+        server.stop(grace=None)
+        journal.configure(previous_path)
+
+    result = trace_mod.assemble([journal_path])
+    assert result["invariant_problems"] == []
+    chrome = result["chrome"]
+    assert trace_mod.validate_chrome_trace(chrome) == []
+    by_id = {s["span_id"]: s for s in result["spans"]}
+    children = trace_mod.span_children(result["spans"])
+    # Every completed trace has its full chain; check one end to end.
+    trace_id = completed_traces[0]
+    assert trace_id in by_id, "task.lifetime root span missing"
+    root = by_id[trace_id]
+    kids = {span["name"] for span in children.get(trace_id, ())}
+    assert {
+        "worker.get_task", "worker.task", "worker.report_task",
+    } <= kids, kids
+    task_span = next(
+        span for span in children[trace_id] if span["name"] == "worker.task"
+    )
+    phase_names = {
+        span["name"] for span in children.get(task_span["span_id"], ())
+    }
+    assert {"step.data_wait", "step.execute"} <= phase_names
+    rpc_names = {
+        span["name"]
+        for span in result["spans"]
+        if span["trace_id"] == trace_id
+    }
+    assert {"rpc.get_task", "rpc.report_task_result"} <= rpc_names
+    # Nesting survived assembly: every span of the trace sits inside
+    # the root's aligned extent, and none has negative duration.
+    for span in result["spans"]:
+        if span["trace_id"] != trace_id:
+            continue
+        assert span["end"] >= span["start"]
+        assert root["start"] - 1e-9 <= span["start"]
+        assert span["end"] <= root["end"] + 1e-9
+    # The journal on disk is schema-valid, including the new events.
+    completed = subprocess.run(
+        [sys.executable, os.path.join("scripts", "validate_journal.py"),
+         journal_path],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert completed.returncode == 0, (
+        completed.stdout + completed.stderr
+    )
