@@ -4,8 +4,9 @@
 # targets are the explicit developer entry points.
 
 .PHONY: all proto native test test-fast test-sparse sparse-gates \
-        test-compile compile-gates test-chaos test-obs e2e bench \
-        bench-regress wheel clean lint check-invariants
+        test-compile compile-gates test-chaos test-obs test-serving \
+        serving-gates e2e bench bench-regress wheel clean lint \
+        check-invariants
 
 all: proto native test
 
@@ -56,8 +57,24 @@ lint:
 # test-fast's own `pytest tests/` sweep, so chaining the full
 # test-sparse / test-compile targets would run them twice per tier-1
 # pass.
-test-fast: lint sparse-gates compile-gates
+test-fast: lint sparse-gates compile-gates serving-gates
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# Script gate of the serving plane, shared by test-serving and
+# test-fast: the load generator's no-server selftest (stream
+# determinism + hot-key skew, outcome classification, closed/open-loop
+# accounting against a fake backend).
+serving-gates:
+	JAX_PLATFORMS=cpu python scripts/loadgen.py --selftest
+
+# Standalone serving-plane gate (docs/serving.md): export round-trip,
+# micro-batcher units (latency-budget vs batch-size race, shed-on-full,
+# deadline drops), padded-bucket no-retrace under the RetraceWatcher,
+# in-process hot-swap equivalence, and — without `-m 'not slow'` — the
+# supervised-fleet acceptance e2e (live hot-swap with zero dropped
+# in-flight, SIGKILL relaunch, journal schema validation).
+test-serving: serving-gates
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
 
 # Script gates of the sparse path, shared by test-sparse and test-fast:
 # the xla-vs-fused microbench's interpret-mode selftest and a tiny

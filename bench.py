@@ -135,6 +135,15 @@ SELF_BASELINE = {
     # 192k->157k strict regression was caught by a judge reading prose,
     # not by the bench); vs_baseline tracks the round-4 measurement.
     "deepfm_26m_strict_samples_per_sec_per_chip": 272_953.0,
+    # Online serving plane (round 13, PROVISIONAL): per-replica request
+    # throughput and client-observed p99 through the exported-artifact ->
+    # ServingReplica -> MicroBatcher path, closed loop of 8 clients at 8
+    # rows/request.  Anchors are the first CI-host (CPU) harness
+    # measurement — no chip number exists yet; both rows are emitted
+    # tracked:false (and the p99 row must STAY untracked: lower-is-
+    # better inverts the regression gate's ratio direction).
+    "deepfm_serve_qps_per_replica": 12_479.0,
+    "deepfm_serve_p99_ms": 1.0,
     # First measured in round 2 (no earlier number exists); vs_baseline
     # therefore tracks drift against the round-2 recording in BASELINE.md.
     "resnet50_images_per_sec_per_chip": 1_524.0,
@@ -278,6 +287,116 @@ def bench_deepfm_fused_multichip():
     return bench_deepfm(
         sparse_kernel="fused", mesh_config=MeshConfig(data=1, model=n)
     )
+
+
+def bench_deepfm_serve(
+    vocab: int = 100_000,
+    request_rows: int = 8,
+    requests_per_round: int = 200,
+    rounds: int = 5,
+    concurrency: int = 8,
+    max_batch_size: int = 64,
+):
+    """Per-replica serving throughput + client-observed tail latency
+    through the REAL online path: exported artifact -> ServingReplica
+    (CompilePlan'd serve_step) -> MicroBatcher (padded power-of-two
+    buckets under a 2 ms budget), driven by a closed loop of
+    `concurrency` clients issuing `request_rows`-row requests
+    back-to-back (in-process — the gRPC hop is deliberately excluded so
+    the row tracks the compute path, not loopback weather).  QPS counts
+    served REQUESTS for one replica; p99 includes queueing + batching +
+    execute.  p99 is LOWER-is-better — the regression gate's ratio
+    direction assumes higher-is-better, so that row must stay
+    tracked:false even after a chip anchor lands (bench_regress.py)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from elasticdl_tpu.parallel import MeshConfig, build_mesh
+    from elasticdl_tpu.parallel.ps_trainer import ShardedEmbeddingTrainer
+    from elasticdl_tpu.serving.batcher import BatcherConfig, MicroBatcher
+    from elasticdl_tpu.serving.export import export_model
+    from elasticdl_tpu.serving.runtime import ServingReplica
+    from model_zoo.deepfm import deepfm_functional_api as zoo
+
+    mesh = build_mesh(MeshConfig())
+    trainer = ShardedEmbeddingTrainer(
+        zoo.custom_model(vocab_size=vocab),
+        zoo.loss,
+        zoo.optimizer(),
+        mesh,
+        embedding_optimizer=zoo.embedding_optimizer(),
+    )
+    rng = np.random.RandomState(0)
+
+    def make_features(rows):
+        return {
+            "dense": rng.rand(rows, zoo.NUM_DENSE).astype(np.float32),
+            "cat": rng.randint(
+                0, vocab, size=(rows, zoo.NUM_CAT)
+            ).astype(np.int32),
+        }
+
+    trainer.ensure_initialized(make_features(request_rows))
+    model_dir = tempfile.mkdtemp(prefix="bench_serve_")
+    try:
+        export_model(
+            trainer, model_dir,
+            model_zoo="model_zoo",
+            model_def="deepfm.deepfm_functional_api",
+            model_params=f"vocab_size={vocab}",
+        )
+        replica = ServingReplica(model_dir, model_zoo="model_zoo")
+        batcher = MicroBatcher(
+            replica.execute,
+            BatcherConfig(max_batch_size=max_batch_size, max_wait_us=2000,
+                          queue_limit=512),
+        ).start()
+        try:
+            replica.warmup(make_features(1), batcher.buckets)
+            pool = [make_features(request_rows) for _ in range(64)]
+
+            def run_round():
+                latencies = []
+                lat_lock = threading.Lock()
+
+                def client(w):
+                    for i in range(w, requests_per_round, concurrency):
+                        t0 = time.perf_counter()
+                        batcher.predict(pool[i % len(pool)])
+                        dt = time.perf_counter() - t0
+                        with lat_lock:
+                            latencies.append(dt)
+
+                threads = [
+                    threading.Thread(target=client, args=(w,),
+                                     name=f"bench-serve-{w}", daemon=True)
+                    for w in range(concurrency)
+                ]
+                start = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                elapsed = time.perf_counter() - start
+                latencies.sort()
+                p99 = latencies[min(len(latencies) - 1,
+                                    int(round(0.99 * (len(latencies) - 1))))]
+                return elapsed, p99 * 1e3
+
+            run_round()  # warmup the full concurrent path
+            measured = [run_round() for _ in range(rounds)]
+            qps, qps_spread = _median_spread(
+                [elapsed for elapsed, _ in measured], requests_per_round
+            )
+            p99s = sorted(p99 for _, p99 in measured)
+            p99_median = p99s[len(p99s) // 2]
+            p99_spread = (p99s[-1] - p99s[0]) / p99_median
+            return qps, qps_spread, p99_median, p99_spread
+        finally:
+            batcher.stop()
+    finally:
+        shutil.rmtree(model_dir, ignore_errors=True)
 
 
 def bench_deepfm_table_scale():
@@ -807,6 +926,28 @@ def _roofline_fields(metric: str, value: float) -> dict:
             "flops_per_sec": round(achieved, -9),
             "mfu": round(achieved / PEAK_BF16_FLOPS, 3),
         }
+    if metric == "deepfm_serve_qps_per_replica":
+        # Forward-only sparse work: 8 samples/request x 26 touched
+        # rows/sample (bench_deepfm_serve defaults).  The provisional
+        # CPU-host anchor is bound by per-request dispatch, not the
+        # chip's sparse floor — floor_frac says how far the number sits
+        # from row-count-bound serving.
+        ns_per_row = 1e9 / (value * 8 * 26)
+        return {
+            "ns_per_row": round(ns_per_row, 1),
+            "floor_frac": round(SPARSE_FLOOR_NS_PER_ROW / ns_per_row, 3),
+            "bound": "host-dispatch",
+        }
+    if metric == "deepfm_serve_p99_ms":
+        # Latency row: the anchor is the device floor for one full
+        # 64-row bucket (64 x 26 rows at the sparse floor) as a
+        # fraction of the observed p99 — everything above the fraction
+        # is queue/batch/dispatch, the batcher's tunable share.
+        floor_ms = 64 * 26 * SPARSE_FLOOR_NS_PER_ROW / 1e6
+        return {
+            "floor_frac": round(floor_ms / value, 3),
+            "bound": "host-dispatch",
+        }
     if metric == "deepfm_e2e_host_pipeline_records_per_sec":
         return {
             "host_parse_frac": round(value / HOST_PARSE_CEILING_RPS, 3),
@@ -1037,6 +1178,31 @@ def main():
             "shard_map'd fused dispatch awaits multi-chip driver "
             "evidence (BASELINE.md queued chip work); on 1 device this "
             "degenerates to the single-chip fused number"
+        ),
+    )
+    serve_qps, sq_spread, serve_p99, sp_spread = bench_deepfm_serve()
+    _emit(
+        "deepfm_serve_qps_per_replica",
+        serve_qps,
+        "requests/sec/replica",
+        sq_spread,
+        tracked=False,
+        untracked_reason=(
+            "provisional CI-host anchor, no chip measurement yet "
+            "(BASELINE.md serving plane); flips tracked with the first "
+            "driver recording"
+        ),
+    )
+    _emit(
+        "deepfm_serve_p99_ms",
+        serve_p99,
+        "ms",
+        sp_spread,
+        tracked=False,
+        untracked_reason=(
+            "lower-is-better: the regression gate's ratio direction "
+            "assumes higher-is-better, so this row reports but must "
+            "never gate (scripts/bench_regress.py)"
         ),
     )
     # The north-star headline prints LAST (the driver parses the final

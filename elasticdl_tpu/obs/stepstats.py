@@ -50,6 +50,14 @@ logger = get_logger("obs.stepstats")
 #: The exclusive sub-phases of a training step's wall time.
 PHASES = ("data_wait", "stage", "compile", "execute", "bookkeep")
 
+#: The exclusive sub-phases of one SERVING request's wall time (the
+#: serving plane's twin of PHASES — serving/batcher.py stamps them,
+#: serving/ledger.py accounts them, and obs.top's --serving mode
+#: renders the per-replica fractions).  ``queue`` = admission to batch
+#: formation, ``batch`` = stacking + bucket padding, ``execute`` = the
+#: compiled inference dispatch, ``respond`` = result hand-off.
+REQUEST_PHASES = ("queue", "batch", "execute", "respond")
+
 #: Host-side phases: when these dominate, the accelerator is starved.
 HOST_PHASES = ("data_wait", "stage", "bookkeep")
 

@@ -10,6 +10,14 @@ labels).
     python -m elasticdl_tpu.obs.top --addr localhost:9090
     python -m elasticdl_tpu.obs.top --addr localhost:9090 --once
 
+``--serving`` switches to the serving-plane table: point ``--addr`` at
+any serving replica's metrics port and the per-replica rows fold from
+the fleet's shared journal (`serving_telemetry` events land in one
+events.jsonl per serve dir, so one replica's /journal shows them all),
+while the header carries the scraped replica's own availability
+gauges.  Against a training-only master the serving table degrades to
+an empty-table note, never a crash.
+
 Stdlib only, read-only, and safe against a mid-scrape master restart
 (connection errors render as a status line, not a crash).
 """
@@ -44,6 +52,20 @@ _COLUMNS = (
 #: (obs/stepstats.PHASES; data_wait / stage / compile / execute /
 #: bookkeep — the per-worker phase-fraction columns).
 _PHASE_COLUMNS = ("data_wait", "stage", "compile", "execute", "bookkeep")
+
+#: Serving-plane header gauges (one replica's exporter; the table rows
+#: are fleet-wide via the shared journal).
+_SERVING_HEADER_GAUGES = (
+    ("elasticdl_serving_availability_ratio", "avail"),
+    ("elasticdl_serving_qps", "qps"),
+    ("elasticdl_serving_latency_p50_ms", "p50ms"),
+    ("elasticdl_serving_latency_p99_ms", "p99ms"),
+)
+
+_SERVING_COLUMNS = (
+    "REPLICA", "AGE(s)", "GEN", "STEP", "QPS", "P50(ms)", "P99(ms)",
+    "QUEUE", "INFLT", "AVAIL%", "SERVED", "SHED", "ERR",
+)
 
 
 def fetch_text(url: str, timeout_s: float = 5.0) -> str:
@@ -187,6 +209,105 @@ def worker_rows(
     return rows
 
 
+def serving_rows(
+    events: List[dict], now: Optional[float] = None
+) -> List[dict]:
+    """Fold the journal tail into one row per serving replica: the
+    latest ``serving_telemetry`` snapshot (replica ids are never reused,
+    so a SIGKILLed replica's stale row ages out of the tail while its
+    replacement appears under a fresh id)."""
+    now = time.time() if now is None else now
+    latest: Dict[int, dict] = {}
+    for event in events:
+        if event.get("event") != "serving_telemetry":
+            continue
+        rid = event.get("replica_id")
+        if rid is None:
+            continue
+        latest[rid] = event
+    rows = []
+    for rid in sorted(latest):
+        event = latest[rid]
+        avail = event.get("availability_ratio")
+        rows.append(
+            {
+                "replica": rid,
+                "age_s": round(max(0.0, now - float(event.get("ts", now))), 1),
+                "generation": event.get("generation", 0),
+                "step": event.get("step", 0),
+                "qps": float(event.get("qps", 0.0) or 0.0),
+                "p50_ms": event.get("p50_ms"),
+                "p99_ms": event.get("p99_ms"),
+                "queue_depth": event.get("queue_depth", 0),
+                "inflight": event.get("inflight", 0),
+                "availability_pct": _pct(avail),
+                "served": event.get("served", 0),
+                "shed": event.get("shed", 0),
+                "errors": event.get("errors", 0),
+            }
+        )
+    return rows
+
+
+def render_serving(
+    rows: List[dict],
+    metrics: Dict[str, float],
+    addr: str = "",
+    notes: Optional[List[str]] = None,
+) -> str:
+    """One serving-plane status frame as plain text."""
+    header_bits = []
+    for name, label in _SERVING_HEADER_GAUGES:
+        if name in metrics:
+            header_bits.append(f"{label}={metrics[name]:.2f}")
+    lines = [
+        f"elasticdl top (serving) — {addr}  " + "  ".join(header_bits),
+    ]
+    table: List[Tuple[str, ...]] = [_SERVING_COLUMNS]
+    for row in rows:
+        table.append(
+            (
+                str(row["replica"]),
+                f"{row['age_s']:.1f}",
+                str(row["generation"]),
+                str(row["step"]),
+                f"{row['qps']:.1f}",
+                _fixed_ms(row["p50_ms"]),
+                _fixed_ms(row["p99_ms"]),
+                str(row["queue_depth"]),
+                str(row["inflight"]),
+                str(row["availability_pct"]),
+                str(row["served"]),
+                str(row["shed"]),
+                str(row["errors"]),
+            )
+        )
+    widths = [
+        max(len(line[col]) for line in table)
+        for col in range(len(_SERVING_COLUMNS))
+    ]
+    for line in table:
+        lines.append(
+            "  ".join(cell.ljust(width) for cell, width in zip(line, widths))
+            .rstrip()
+        )
+    if not rows:
+        lines.append(
+            "(no serving_telemetry events in the journal tail — is this a "
+            "training-only master?)"
+        )
+    for note in notes or ():
+        lines.append(note)
+    return "\n".join(lines)
+
+
+def _fixed_ms(value) -> str:
+    """Already-in-ms telemetry field (unlike `_ms`, which converts)."""
+    if value is None:
+        return "-"
+    return f"{float(value):.1f}"
+
+
 def _ms(seconds) -> str:
     if seconds is None:
         return "-"
@@ -254,7 +375,7 @@ def render(
     return "\n".join(lines)
 
 
-def snapshot_frame(addr: str, tail: int = 256) -> str:
+def snapshot_frame(addr: str, tail: int = 256, serving: bool = False) -> str:
     base = addr if "://" in addr else f"http://{addr}"
     metrics_text = fetch_text(base + "/metrics")
     # The journal endpoint is newer than /metrics: an old master without
@@ -266,6 +387,13 @@ def snapshot_frame(addr: str, tail: int = 256) -> str:
         events = journal.get("events", [])
     except (urllib.error.URLError, OSError, ValueError) as exc:
         notes.append(f"(journal endpoint unavailable: {exc})")
+    if serving:
+        return render_serving(
+            serving_rows(events),
+            parse_metrics(metrics_text),
+            addr,
+            notes=notes,
+        )
     job_header = "  ".join(
         part
         for part in (goodput_header(metrics_text), policy_header(events))
@@ -300,10 +428,15 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--once", action="store_true", help="print one frame and exit"
     )
+    parser.add_argument(
+        "--serving", action="store_true",
+        help="render the serving-plane table (point --addr at any "
+        "serving replica's metrics port)",
+    )
     args = parser.parse_args(argv)
     while True:
         try:
-            frame = snapshot_frame(args.addr, args.tail)
+            frame = snapshot_frame(args.addr, args.tail, serving=args.serving)
         except (urllib.error.URLError, OSError, ValueError) as exc:
             frame = f"elasticdl top — {args.addr} unreachable: {exc}"
         if args.once:

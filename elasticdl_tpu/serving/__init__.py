@@ -3,3 +3,12 @@ from elasticdl_tpu.serving.export import (  # noqa: F401
     export_model,
     load_for_serving,
 )
+
+# The online runtime (batched inference + hot swap + supervision) lives
+# in submodules imported lazily by callers — serving/export.py must stay
+# importable without grpc for offline export tooling:
+#   serving.runtime    ServingReplica, serving_rules
+#   serving.batcher    MicroBatcher, BatcherConfig, QueueFullError
+#   serving.ledger     AvailabilityLedger, ledger
+#   serving.frontend   ServingFrontend, PredictClient
+#   serving.supervisor ServingReplicaManager, start_serving_fleet

@@ -1,0 +1,361 @@
+"""Micro-batching front door for the serving plane.
+
+Concurrent predict requests are aggregated into one device dispatch
+under a latency budget: a batch closes when it reaches
+``max_batch_size`` OR when its oldest request has waited
+``max_wait_us``, whichever comes first (the classic serving trade —
+throughput wants big batches, tail latency wants prompt ones).
+
+Two properties are load-bearing:
+
+- **Padded-bucket shapes.**  Every dispatched batch is padded up to a
+  fixed bucket size (powers of two up to ``max_batch_size``), so the
+  compiled inference step sees at most ``len(buckets)`` distinct batch
+  shapes — after warmup, no retraces (the PR 8 ``RetraceWatcher`` gates
+  this in tests/test_serving.py).  Model rows are independent (the
+  DeepFM contract: one logit per row), so padding rows cannot perturb
+  real rows; pad outputs are sliced off before requests complete.
+- **Explicit load shedding.**  Admission is a bounded queue
+  (``queue_limit``); a request arriving at a full queue is REJECTED
+  immediately with ``QueueFullError`` — journaled as a schema-registered
+  ``request_shed`` event — instead of silently growing an unbounded
+  backlog whose every entry would miss its deadline anyway (the
+  availability ledger counts it dropped; docs/serving.md).
+
+All clocks are host-side and the batcher thread never holds its lock
+across the execute callable (a device dispatch under the admission lock
+would couple enqueue latency to device latency).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.analysis.runtime import make_lock
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("serving.batcher")
+
+_SHED = obs.counter(
+    "elasticdl_serving_shed_total",
+    "Requests rejected at admission, by cause",
+    labelnames=("reason",),
+)
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity: the request was shed, not queued."""
+
+
+class RequestError(RuntimeError):
+    """The batch this request rode failed to execute."""
+
+
+def bucket_sizes(max_batch_size: int) -> Tuple[int, ...]:
+    """Power-of-two padding buckets up to (and including) the max batch
+    size — the fixed shape set the compiled step may see."""
+    if max_batch_size < 1:
+        raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+    sizes = []
+    size = 1
+    while size < max_batch_size:
+        sizes.append(size)
+        size *= 2
+    sizes.append(max_batch_size)
+    return tuple(sizes)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """The smallest bucket holding n rows."""
+    for size in buckets:
+        if n <= size:
+            return size
+    return buckets[-1]
+
+
+def pad_features(features: Dict[str, np.ndarray], rows: int) -> Dict[str, np.ndarray]:
+    """Zero-pad every array of a features dict to `rows` along axis 0.
+    Id 0 is a valid embedding row, but pad rows' outputs are sliced off
+    before any request sees them and model rows are independent."""
+    out = {}
+    for key, array in features.items():
+        array = np.asarray(array)
+        if array.shape[0] == rows:
+            out[key] = array
+            continue
+        pad = np.zeros((rows - array.shape[0],) + array.shape[1:], array.dtype)
+        out[key] = np.concatenate([array, pad], axis=0)
+    return out
+
+
+@dataclass(eq=False)  # identity semantics: fields hold numpy arrays
+class _Pending:
+    """One admitted request riding the queue."""
+
+    features: Dict[str, np.ndarray]
+    rows: int
+    enqueued_at: float
+    deadline: Optional[float]  # monotonic; None = no deadline
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[np.ndarray] = None
+    error: Optional[BaseException] = None
+    # Phase clocks filled in by the batcher thread (queue/batch/execute/
+    # respond — obs/stepstats.REQUEST_PHASES).
+    phases: Dict[str, float] = field(default_factory=dict)
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError("predict result not ready in time")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+@dataclass(frozen=True)
+class BatcherConfig:
+    max_batch_size: int = 64
+    max_wait_us: int = 2000
+    queue_limit: int = 256
+
+
+class MicroBatcher:
+    """Aggregates admitted requests into padded-bucket dispatches.
+
+    ``execute_fn(features, n_valid)`` runs the compiled inference step on
+    a padded batch and returns outputs with the batch on axis 0;
+    ``on_request(phases, outcome, rows)`` (optional) feeds the
+    availability ledger.  Start/stop own the single batcher thread.
+    """
+
+    def __init__(
+        self,
+        execute_fn: Callable[[Dict[str, np.ndarray], int], np.ndarray],
+        config: BatcherConfig = BatcherConfig(),
+        on_request: Optional[Callable[[Dict[str, float], str, int], None]] = None,
+        on_shed: Optional[Callable[[int], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._execute_fn = execute_fn
+        self._config = config
+        self._on_request = on_request
+        self._on_shed = on_shed
+        self._clock = clock
+        self._buckets = bucket_sizes(config.max_batch_size)
+        self._lock = make_lock("MicroBatcher._lock")
+        self._queue: deque = deque()  # guarded-by: _lock
+        self._queued_rows = 0  # guarded-by: _lock
+        self._wakeup = threading.Condition(self._lock)
+        self._stopped = False  # guarded-by: _lock
+        self._thread: Optional[threading.Thread] = None
+        self._m_depth = obs.gauge(
+            "elasticdl_serving_queue_depth",
+            "Requests currently waiting for a batch slot",
+        )
+        self._m_depth.set_function(lambda: len(self._queue))
+        self._m_batch_rows = obs.histogram(
+            "elasticdl_serving_batch_rows",
+            "Real (unpadded) rows per dispatched batch",
+            buckets=tuple(float(b) for b in self._buckets),
+        )
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._buckets
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        self._thread = threading.Thread(
+            target=self._run, name="serving-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            self._wakeup.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # Fail any stragglers still queued so no caller blocks forever.
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+        for req in pending:
+            req.error = RequestError("batcher stopped")
+            req.done.set()
+
+    # -- admission ------------------------------------------------------
+
+    def submit(
+        self,
+        features: Dict[str, np.ndarray],
+        deadline_s: Optional[float] = None,
+    ) -> _Pending:
+        """Admit one request (all arrays share axis-0 row count).  Raises
+        QueueFullError when the admission queue is at capacity — the
+        explicit shed, never a silent unbounded backlog."""
+        rows = int(np.asarray(next(iter(features.values()))).shape[0])
+        if rows > self._config.max_batch_size:
+            raise ValueError(
+                f"request rows {rows} exceed max_batch_size "
+                f"{self._config.max_batch_size}; split the request"
+            )
+        now = self._clock()
+        req = _Pending(
+            features={k: np.asarray(v) for k, v in features.items()},
+            rows=rows,
+            enqueued_at=now,
+            deadline=(now + deadline_s) if deadline_s else None,
+        )
+        with self._lock:
+            if self._stopped:
+                raise RequestError("batcher stopped")
+            if len(self._queue) >= self._config.queue_limit:
+                depth = len(self._queue)
+                shed = True
+            else:
+                shed = False
+                self._queue.append(req)
+                self._queued_rows += rows
+                self._wakeup.notify()
+        if shed:
+            _SHED.inc(reason="queue_full")
+            obs.journal().record(
+                "request_shed",
+                reason="queue_full",
+                queue_depth=depth,
+                queue_limit=self._config.queue_limit,
+                rows=rows,
+            )
+            if self._on_shed is not None:
+                self._on_shed(rows)
+            raise QueueFullError(
+                f"admission queue full ({depth}/{self._config.queue_limit})"
+            )
+        return req
+
+    def predict(
+        self,
+        features: Dict[str, np.ndarray],
+        deadline_s: Optional[float] = None,
+        wait_timeout_s: Optional[float] = 60.0,
+    ) -> np.ndarray:
+        """submit + wait, the synchronous convenience used by the
+        frontend's request handler threads."""
+        return self.submit(features, deadline_s).wait(wait_timeout_s)
+
+    # -- the batcher thread ---------------------------------------------
+
+    def _take_batch(self) -> List[_Pending]:
+        """Block until a batch is due (full, or the oldest admitted
+        request has waited max_wait_us), then pop it.  Empty list on
+        stop."""
+        max_wait_s = self._config.max_wait_us / 1e6
+        with self._lock:
+            while True:
+                if self._stopped:
+                    return []
+                if self._queued_rows >= self._config.max_batch_size:
+                    break
+                if self._queue:
+                    age = self._clock() - self._queue[0].enqueued_at
+                    if age >= max_wait_s:
+                        break
+                    self._wakeup.wait(timeout=max_wait_s - age)
+                else:
+                    self._wakeup.wait(timeout=0.1)
+            batch: List[_Pending] = []
+            rows = 0
+            while self._queue:
+                if rows + self._queue[0].rows > self._config.max_batch_size:
+                    break
+                req = self._queue.popleft()
+                self._queued_rows -= req.rows
+                rows += req.rows
+                batch.append(req)
+            return batch
+
+    def _run(self):
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return
+            try:
+                self._dispatch(batch)
+            except Exception:  # never kill the batcher thread
+                logger.exception("batch dispatch failed")
+
+    def _dispatch(self, batch: List[_Pending]):
+        t_batch = self._clock()
+        for req in batch:
+            req.phases["queue"] = max(0.0, t_batch - req.enqueued_at)
+        expired = [
+            r for r in batch if r.deadline is not None and t_batch > r.deadline
+        ]
+        live = [r for r in batch if r not in expired]
+        for req in expired:
+            _SHED.inc(reason="deadline")
+            obs.journal().record(
+                "request_shed", reason="deadline", rows=req.rows,
+                waited_s=round(req.phases["queue"], 6),
+            )
+            self._finish(req, None, RequestError("deadline expired in queue"),
+                         outcome="dropped")
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        stacked = {
+            key: np.concatenate(
+                [np.asarray(r.features[key]) for r in live], axis=0
+            )
+            for key in live[0].features
+        }
+        padded = pad_features(stacked, bucket_for(rows, self._buckets))
+        t_exec = self._clock()
+        batch_s = t_exec - t_batch
+        self._m_batch_rows.observe(float(rows))
+        try:
+            outputs = np.asarray(self._execute_fn(padded, rows))
+        except Exception as exc:
+            t_done = self._clock()
+            for req in live:
+                req.phases["batch"] = batch_s
+                req.phases["execute"] = t_done - t_exec
+                self._finish(req, None, RequestError(f"execute failed: {exc}"),
+                             outcome="error")
+            raise
+        t_respond = self._clock()
+        execute_s = t_respond - t_exec
+        offset = 0
+        for req in live:
+            req.phases["batch"] = batch_s
+            req.phases["execute"] = execute_s
+            result = outputs[offset:offset + req.rows]
+            offset += req.rows
+            self._finish(req, result, None, outcome="served")
+
+    def _finish(self, req: _Pending, result, error, outcome: str):
+        t0 = self._clock()
+        req.result = result
+        req.error = error
+        req.done.set()
+        req.phases["respond"] = self._clock() - t0
+        if self._on_request is not None:
+            try:
+                self._on_request(dict(req.phases), outcome, req.rows)
+            except Exception:
+                logger.exception("availability-ledger callback failed")

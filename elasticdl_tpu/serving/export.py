@@ -213,6 +213,10 @@ class ServingModel:
         return outputs
 
     @property
+    def model(self):
+        return self._model
+
+    @property
     def variables(self) -> Dict:
         return self._variables
 

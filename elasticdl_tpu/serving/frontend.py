@@ -1,0 +1,275 @@
+"""Threaded gRPC predict frontend for one serving replica.
+
+The environment ships `protoc` without the gRPC plugin, so — like
+proto/service.py for the Master service — the stub/servicer glue is
+written by hand.  Predict payloads are not protobuf messages at all:
+features and outputs ride the npz/npy wire codec below (numpy's own
+portable serialization) through identity byte serializers, which keeps
+the proto surface at zero while staying a real gRPC service (deadlines,
+status codes, metadata all work normally).
+
+Methods (service ``elasticdl_tpu.Predict``):
+
+- ``predict``: npz-encoded features dict -> npy-encoded outputs.  The
+  server derives the batcher deadline from the CLIENT's gRPC deadline
+  (``context.time_remaining()``), so per-request deadlines are set in
+  exactly one place — the caller's `RetryPolicy.timeout_s`
+  (common/grpc_utils.py).  A shed request returns RESOURCE_EXHAUSTED
+  (the explicit backpressure signal); a deadline lapse returns
+  DEADLINE_EXCEEDED.
+- ``reload``: JSON ``{"model_dir": ...}`` -> JSON replica stats after
+  the hot swap (serving/runtime.py does the generation dance).
+- ``stats``: JSON replica + availability-ledger snapshot (the loadgen
+  and obs.top's serving mode read the same numbers from the exporter;
+  this RPC is for point debugging).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from typing import Dict, Optional
+
+import grpc
+import numpy as np
+
+from elasticdl_tpu.common import grpc_utils
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.serving.batcher import MicroBatcher, QueueFullError
+
+logger = get_logger("serving.frontend")
+
+_SERVICE_NAME = "elasticdl_tpu.Predict"
+_METHODS = ("predict", "reload", "stats")
+
+#: Server-side floor under the client deadline: leave headroom for the
+#: response to travel back instead of computing a result nobody waits for.
+_DEADLINE_HEADROOM_S = 0.005
+
+
+# ---------------------------------------------------------------------------
+# Wire codec: numpy's own portable serialization as the message format
+# ---------------------------------------------------------------------------
+
+
+def encode_features(features: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in features.items()})
+    return buf.getvalue()
+
+
+def decode_features(payload: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(payload)) as npz:
+        return {k: npz[k] for k in npz.files}
+
+
+def encode_array(array: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(array))
+    return buf.getvalue()
+
+
+def decode_array(payload: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(payload))
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Servicer + server
+# ---------------------------------------------------------------------------
+
+
+class PredictServicer:
+    """Request handlers running on the gRPC thread pool; the batcher
+    thread owns the device, so handlers only block on `_Pending.wait`."""
+
+    def __init__(self, replica, batcher: MicroBatcher):
+        self._replica = replica
+        self._batcher = batcher
+
+    def predict(self, request: bytes, context) -> bytes:
+        try:
+            features = decode_features(request)
+        except Exception as exc:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"bad features payload: {exc}"
+            )
+        remaining = context.time_remaining()
+        deadline_s = None
+        if remaining is not None and remaining < 3600:
+            deadline_s = max(0.0, remaining - _DEADLINE_HEADROOM_S)
+        try:
+            outputs = self._batcher.predict(
+                features,
+                deadline_s=deadline_s,
+                wait_timeout_s=(remaining if remaining is not None else 60.0),
+            )
+        except QueueFullError as exc:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+        except TimeoutError as exc:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
+        except ValueError as exc:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        except RuntimeError as exc:
+            # RequestError: dropped on deadline in queue, or execute failed.
+            code = (
+                grpc.StatusCode.DEADLINE_EXCEEDED
+                if "deadline" in str(exc)
+                else grpc.StatusCode.INTERNAL
+            )
+            context.abort(code, str(exc))
+        return encode_array(outputs)
+
+    def reload(self, request: bytes, context) -> bytes:
+        try:
+            model_dir = json.loads(request.decode("utf-8"))["model_dir"]
+        except Exception as exc:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"bad reload payload: {exc}"
+            )
+        try:
+            self._replica.reload(model_dir)
+        except Exception as exc:
+            logger.exception("hot-swap reload failed")
+            context.abort(grpc.StatusCode.INTERNAL, f"reload failed: {exc}")
+        return self.stats(b"", context)
+
+    def stats(self, request: bytes, context) -> bytes:
+        from elasticdl_tpu.serving.ledger import ledger
+
+        payload = dict(self._replica.stats())
+        payload["queue_depth"] = self._batcher.queue_depth()
+        payload["ledger"] = ledger().snapshot()
+        return json.dumps(payload).encode("utf-8")
+
+
+def add_PredictServicer_to_server(servicer, server):
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=_identity,
+            response_serializer=_identity,
+        )
+        for name in _METHODS
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_SERVICE_NAME, handlers),)
+    )
+
+
+class PredictStub:
+    """Raw client stub (bytes in/bytes out); most callers want
+    `PredictClient` below."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name in _METHODS:
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{_SERVICE_NAME}/{name}",
+                    request_serializer=_identity,
+                    response_deserializer=_identity,
+                ),
+            )
+
+
+class ServingFrontend:
+    """The replica's listening edge: grpc_utils server + PredictServicer.
+    `start()` binds (port 0 = ephemeral) and returns the bound port."""
+
+    def __init__(
+        self,
+        replica,
+        batcher: MicroBatcher,
+        port: int = 0,
+        max_workers: int = 16,
+    ):
+        self._servicer = PredictServicer(replica, batcher)
+        self._server = grpc_utils.build_server(max_workers=max_workers)
+        add_PredictServicer_to_server(self._servicer, self._server)
+        self._requested_port = port
+        self.port: Optional[int] = None
+
+    def start(self) -> int:
+        self.port = self._server.add_insecure_port(
+            f"[::]:{self._requested_port}"
+        )
+        self._server.start()
+        logger.info("Predict frontend listening on port %d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 2.0):
+        self._server.stop(grace).wait()
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class PredictClient:
+    """Typed client over the byte stub: codec + per-request deadline +
+    the shared retry plane (predict is idempotent — a retried request
+    recomputes the same rows)."""
+
+    def __init__(self, addr: str, deadline_s: float = 10.0):
+        self._addr = addr
+        self._channel = grpc_utils.build_channel(addr)
+        self._stub = PredictStub(self._channel)
+        self._policy = grpc_utils.RetryPolicy(
+            timeout_s=deadline_s,
+            max_attempts=grpc_utils.IDEMPOTENT_POLICY.max_attempts,
+            wait_for_ready=True,
+        )
+        self._stats = grpc_utils.RetryStats()
+
+    def predict(
+        self,
+        features: Dict[str, np.ndarray],
+        deadline_s: Optional[float] = None,
+    ) -> np.ndarray:
+        policy = self._policy
+        if deadline_s is not None:
+            policy = grpc_utils.RetryPolicy(
+                timeout_s=deadline_s,
+                max_attempts=policy.max_attempts,
+                wait_for_ready=True,
+            )
+        payload = grpc_utils.call_with_retry(
+            self._stub.predict,
+            encode_features(features),
+            method="predict",
+            policy=policy,
+            stats=self._stats,
+            seed=self._addr,
+        )
+        return decode_array(payload)
+
+    def reload(self, model_dir: str, deadline_s: float = 120.0) -> dict:
+        # NOT retried: a reload that already landed should not re-run.
+        payload = self._stub.reload(
+            json.dumps({"model_dir": model_dir}).encode("utf-8"),
+            timeout=deadline_s,
+        )
+        return json.loads(payload.decode("utf-8"))
+
+    def stats(self, deadline_s: float = 10.0) -> dict:
+        payload = grpc_utils.call_with_retry(
+            self._stub.stats,
+            b"",
+            method="stats",
+            policy=grpc_utils.RetryPolicy(
+                timeout_s=deadline_s, max_attempts=2, wait_for_ready=True
+            ),
+            stats=self._stats,
+            seed=self._addr,
+        )
+        return json.loads(payload.decode("utf-8"))
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,197 @@
+"""Served-vs-dropped availability ledger for the serving plane.
+
+The goodput ledger (obs/goodput.py) answers "what fraction of job
+wall-clock trained"; this is its serving twin: "what fraction of
+admitted traffic was served" plus where request wall time went.  Every
+finished request books:
+
+- an outcome (``served`` / ``dropped`` / ``shed`` / ``error`` — a
+  bounded enum, so it may ride a metric label), and
+- its per-phase seconds over the request-phase taxonomy
+  (obs/stepstats.REQUEST_PHASES: queue / batch / execute / respond).
+
+Exported via the obs registry (scraped by the replica's exporter and
+rendered by ``obs.top --serving``):
+
+- ``elasticdl_serving_availability_ratio`` — served / (served+dropped+
+  shed+error) over the process lifetime;
+- ``elasticdl_serving_requests_total{outcome=}`` and
+  ``elasticdl_serving_rows_total{outcome=}``;
+- ``elasticdl_serving_phase_seconds_total{phase=}``;
+- ``elasticdl_serving_latency_p50_ms`` / ``..._p99_ms`` — host-side
+  percentiles over a sliding window (a Prometheus histogram's fixed
+  buckets are too coarse for a p99 SLO readout);
+- ``elasticdl_serving_qps`` — served requests/s over the same window.
+
+Thread-safety: requests finish on the batcher thread while the exporter
+scrapes from its own; the lock covers the sliding window and counters.
+Gauge callbacks read under the ledger lock — percentile math over a
+bounded deque, never a device sync, so a scrape cannot stall serving.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.analysis.runtime import make_lock
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.obs.stepstats import REQUEST_PHASES
+
+logger = get_logger("serving.ledger")
+
+#: Bounded outcome enum (metric-label safe).
+OUTCOMES = ("served", "dropped", "shed", "error")
+
+#: Sliding latency/QPS window (requests).
+WINDOW = 2048
+
+
+class AvailabilityLedger:
+    """Process-wide accounting of request outcomes and phase time."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = make_lock("AvailabilityLedger._lock")
+        self._outcomes = {o: 0 for o in OUTCOMES}  # guarded-by: _lock
+        self._rows = {o: 0 for o in OUTCOMES}  # guarded-by: _lock
+        self._phase_s = {p: 0.0 for p in REQUEST_PHASES}  # guarded-by: _lock
+        # (finish_ts, latency_s) of recent served requests.
+        self._window: deque = deque(maxlen=WINDOW)  # guarded-by: _lock
+        self._m_requests = obs.counter(
+            "elasticdl_serving_requests_total",
+            "Finished predict requests, by outcome",
+            labelnames=("outcome",),
+        )
+        self._m_rows = obs.counter(
+            "elasticdl_serving_rows_total",
+            "Finished predict rows, by outcome",
+            labelnames=("outcome",),
+        )
+        self._m_phase = obs.counter(
+            "elasticdl_serving_phase_seconds_total",
+            "Cumulative request wall time, by request phase",
+            labelnames=("phase",),
+        )
+        obs.gauge(
+            "elasticdl_serving_availability_ratio",
+            "served / all finished requests (1.0 = nothing dropped)",
+        ).set_function(self.availability_ratio)
+        obs.gauge(
+            "elasticdl_serving_latency_p50_ms",
+            "p50 served-request latency over the sliding window",
+        ).set_function(lambda: self.latency_percentile_ms(50.0))
+        obs.gauge(
+            "elasticdl_serving_latency_p99_ms",
+            "p99 served-request latency over the sliding window",
+        ).set_function(lambda: self.latency_percentile_ms(99.0))
+        obs.gauge(
+            "elasticdl_serving_qps",
+            "Served requests/s over the sliding window",
+        ).set_function(self.qps)
+
+    # -- recording ------------------------------------------------------
+
+    def record_request(
+        self, phases: Dict[str, float], outcome: str, rows: int = 1
+    ):
+        """Book one finished request (the MicroBatcher's on_request
+        callback signature).  Unknown phases are ignored; unknown
+        outcomes count as 'error' rather than raising on the batcher
+        thread."""
+        if outcome not in self._outcomes:
+            outcome = "error"
+        latency = sum(
+            float(phases.get(p, 0.0)) for p in REQUEST_PHASES
+        )
+        now = self._clock()
+        with self._lock:
+            self._outcomes[outcome] += 1
+            self._rows[outcome] += int(rows)
+            for phase in REQUEST_PHASES:
+                if phase in phases:
+                    self._phase_s[phase] += float(phases[phase])
+            if outcome == "served":
+                self._window.append((now, latency))
+        self._m_requests.inc(outcome=outcome)
+        self._m_rows.inc(int(rows), outcome=outcome)
+        for phase in REQUEST_PHASES:
+            if phase in phases:
+                self._m_phase.inc(float(phases[phase]), phase=phase)
+
+    def record_shed(self, rows: int = 1):
+        """Book an admission-rejected request (the MicroBatcher's
+        on_shed callback; the batcher itself journals the
+        ``request_shed`` event)."""
+        self.record_request({}, "shed", rows)
+
+    # -- readouts -------------------------------------------------------
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._outcomes)
+
+    def phase_seconds(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._phase_s)
+
+    def availability_ratio(self) -> float:
+        with self._lock:
+            total = sum(self._outcomes.values())
+            if total == 0:
+                return 1.0
+            return self._outcomes["served"] / total
+
+    def latency_percentile_ms(self, pct: float) -> float:
+        with self._lock:
+            latencies = sorted(latency for _, latency in self._window)
+        if not latencies:
+            return 0.0
+        rank = min(
+            len(latencies) - 1, int(round(pct / 100.0 * (len(latencies) - 1)))
+        )
+        return latencies[rank] * 1e3
+
+    def qps(self, horizon_s: float = 10.0) -> float:
+        now = self._clock()
+        with self._lock:
+            recent = [ts for ts, _ in self._window if now - ts <= horizon_s]
+        if not recent:
+            return 0.0
+        span = max(1e-6, now - min(recent))
+        return len(recent) / span
+
+    def snapshot(self) -> dict:
+        """One bounded dict for the replica's serving_telemetry journal
+        event (per-replica detail rides the journal, never labels)."""
+        with self._lock:
+            counts = dict(self._outcomes)
+            phases = {p: round(s, 6) for p, s in self._phase_s.items()}
+        return {
+            "counts": counts,
+            "phase_seconds": phases,
+            "availability_ratio": round(self.availability_ratio(), 6),
+            "p50_ms": round(self.latency_percentile_ms(50.0), 3),
+            "p99_ms": round(self.latency_percentile_ms(99.0), 3),
+            "qps": round(self.qps(), 2),
+        }
+
+
+_ledger: Optional[AvailabilityLedger] = None
+
+
+def ledger() -> AvailabilityLedger:
+    """The process singleton (one serving replica per process)."""
+    global _ledger
+    if _ledger is None:
+        _ledger = AvailabilityLedger()
+    return _ledger
+
+
+def reset_ledger():
+    """Test hook: drop the singleton so a fresh registry snapshot can
+    re-register its gauges."""
+    global _ledger
+    _ledger = None

@@ -1,0 +1,223 @@
+"""Serving replica process entrypoint.
+
+One replica = one process = one `ServingReplica` (device runtime) + one
+`MicroBatcher` (front door) + one `ServingFrontend` (gRPC edge), run
+under the elastic pod manager exactly like a training worker
+(`serving/supervisor.py` builds the argv; a SIGKILLed replica is
+relaunched with a fresh replica id — ids are never reused).
+
+Discovery rides the shared ``--serve_dir``:
+
+- ``replica-<id>.json`` — this replica's bound predict port, metrics
+  port, and pid (atomic tmp+rename write).  `live_replicas()` is the
+  reader: it prunes entries whose pid is gone, so loadgen/e2e always
+  see the surviving fleet across SIGKILL relaunches without a naming
+  service.
+- ``events.jsonl`` — every replica journals into the SHARED serve-dir
+  journal (append mode), so `model_swap` / `request_shed` /
+  ``serving_telemetry`` events from the whole fleet land in one
+  timeline; any one exporter's ``/journal`` endpoint (or
+  ``obs.top --serving``) then shows fleet-wide serving state.
+
+Per-replica detail (qps/p50/p99/queue-depth/generation) is journaled as
+``serving_telemetry`` once per ``--telemetry_interval_s`` — replica id
+is unbounded, so it rides the journal, never a metric label
+(metric-label-cardinality rule).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("serving.replica")
+
+
+# ---------------------------------------------------------------------------
+# Serve-dir discovery
+# ---------------------------------------------------------------------------
+
+
+def replica_info_file(serve_dir: str, replica_id: int) -> str:
+    return os.path.join(serve_dir, f"replica-{replica_id}.json")
+
+
+def write_replica_info(serve_dir: str, replica_id: int, info: dict) -> str:
+    """Atomic tmp+rename publish (a reader never sees a torn write)."""
+    path = replica_info_file(serve_dir, replica_id)
+    fd, tmp = tempfile.mkstemp(prefix="replica.", dir=serve_dir)
+    with os.fdopen(fd, "w") as f:
+        json.dump(info, f)
+    os.replace(tmp, path)
+    return path
+
+
+def live_replicas(serve_dir: str) -> List[dict]:
+    """Every published replica whose pid is still alive, sorted by
+    replica id.  Stale files from SIGKILLed replicas (their relaunch
+    gets a FRESH id) are skipped, not deleted — the journal, not the
+    serve dir, is the record of what happened."""
+    out = []
+    try:
+        names = os.listdir(serve_dir)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith("replica-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(serve_dir, name)) as f:
+                info = json.load(f)
+            os.kill(int(info["pid"]), 0)
+        except (OSError, ValueError, KeyError):
+            continue
+        out.append(info)
+    return sorted(out, key=lambda i: i.get("replica_id", 0))
+
+
+# ---------------------------------------------------------------------------
+# Entrypoint
+# ---------------------------------------------------------------------------
+
+
+def parse_replica_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="elasticdl_tpu serving replica")
+    parser.add_argument("--model_dir", required=True,
+                        help="export.py artifact to serve")
+    parser.add_argument("--serve_dir", required=True,
+                        help="shared discovery + journal directory")
+    parser.add_argument("--replica_id", type=int, default=0)
+    parser.add_argument("--port", type=int, default=0,
+                        help="predict port (0 = ephemeral)")
+    parser.add_argument("--metrics_port", type=int, default=0)
+    parser.add_argument("--model_zoo", default="")
+    parser.add_argument("--sparse_kernel", default="auto",
+                        choices=("xla", "fused", "auto"))
+    parser.add_argument("--max_batch_size", type=int, default=64)
+    parser.add_argument("--max_wait_us", type=int, default=2000)
+    parser.add_argument("--queue_limit", type=int, default=256)
+    parser.add_argument("--telemetry_interval_s", type=float, default=1.0)
+    parser.add_argument("--warmup_features", default="",
+                        help="npz file of one example request; every "
+                             "padded bucket is pre-traced from it")
+    args, unknown = parser.parse_known_args(argv)
+    if unknown:
+        logger.warning("Ignoring unknown replica args: %s", unknown)
+    return args
+
+
+def _telemetry_loop(stop: threading.Event, interval_s: float, replica,
+                    batcher, replica_id: int):
+    from elasticdl_tpu.serving.ledger import ledger
+
+    while not stop.wait(interval_s):
+        snap = ledger().snapshot()
+        stats = replica.stats()
+        obs.journal().record(
+            "serving_telemetry",
+            replica_id=replica_id,
+            generation=stats["generation"],
+            step=stats["step"],
+            inflight=stats["inflight"],
+            queue_depth=batcher.queue_depth(),
+            qps=snap["qps"],
+            p50_ms=snap["p50_ms"],
+            p99_ms=snap["p99_ms"],
+            availability_ratio=snap["availability_ratio"],
+            served=snap["counts"]["served"],
+            dropped=snap["counts"]["dropped"],
+            shed=snap["counts"]["shed"],
+            errors=snap["counts"]["error"],
+        )
+
+
+def main(argv=None) -> int:
+    args = parse_replica_args(argv)
+    os.makedirs(args.serve_dir, exist_ok=True)
+    obs.init_journal(args.serve_dir)
+
+    from elasticdl_tpu.obs.exporter import MetricsExporter
+    from elasticdl_tpu.serving.batcher import BatcherConfig, MicroBatcher
+    from elasticdl_tpu.serving.frontend import ServingFrontend, decode_features
+    from elasticdl_tpu.serving.ledger import ledger
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    replica = ServingReplica(
+        args.model_dir,
+        sparse_kernel=args.sparse_kernel,
+        model_zoo=args.model_zoo,
+    )
+    book = ledger()
+    batcher = MicroBatcher(
+        replica.execute,
+        BatcherConfig(
+            max_batch_size=args.max_batch_size,
+            max_wait_us=args.max_wait_us,
+            queue_limit=args.queue_limit,
+        ),
+        on_request=book.record_request,
+        on_shed=book.record_shed,
+    ).start()
+    if args.warmup_features:
+        with open(args.warmup_features, "rb") as f:
+            example = decode_features(f.read())
+        replica.warmup(example, batcher.buckets)
+        logger.info("Warmed %d bucket shapes", len(batcher.buckets))
+
+    frontend = ServingFrontend(replica, batcher, port=args.port)
+    port = frontend.start()
+    exporter = MetricsExporter(port=args.metrics_port).start()
+    write_replica_info(args.serve_dir, args.replica_id, {
+        "replica_id": args.replica_id,
+        "pid": os.getpid(),
+        "port": port,
+        "metrics_port": exporter.port,
+        "model_dir": args.model_dir,
+    })
+    obs.journal().record(
+        "serving_replica_start",
+        replica_id=args.replica_id,
+        port=port,
+        model_dir=args.model_dir,
+        generation=replica.stats()["generation"],
+    )
+
+    stop = threading.Event()
+
+    def _shutdown(signum, frame):
+        logger.info("Replica %d: signal %d, shutting down", args.replica_id,
+                    signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+
+    telemetry = threading.Thread(
+        target=_telemetry_loop,
+        args=(stop, args.telemetry_interval_s, replica, batcher,
+              args.replica_id),
+        name="serving-telemetry",
+        daemon=True,
+    )
+    telemetry.start()
+
+    while not stop.wait(0.5):
+        pass
+    frontend.stop()
+    batcher.stop()
+    exporter.stop()
+    telemetry.join(timeout=5)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

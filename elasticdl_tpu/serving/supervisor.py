@@ -1,0 +1,200 @@
+"""Elastic supervision for serving replicas.
+
+The paper's elastic control plane — pod manager, restart budget, policy
+engine — is exactly the machinery a serving fleet needs, with ONE
+semantic inversion: training workers form a collective (any death
+invalidates the world: collectives wedge, so the pod manager restarts
+everything), while serving replicas are independent.  A replica death
+must NOT take the survivors down — they are what availability is made
+of.  `ServingReplicaManager` therefore subclasses the subprocess
+substrate and overrides only the churn handler: dead replicas are
+replaced with FRESH ids (never reused, same as workers), survivors keep
+serving, and the same `worker_churn` journal event records the repair.
+
+Everything else is inherited unchanged: `kill_worker()` (the SIGKILL
+e2e), `scale()` (elastic resize), the restart budget, the monitor
+thread, and the policy-engine surface (`current_worker_ids`,
+`kill_worker`, `scale`) — an `ElasticPolicyEngine` binds to this
+manager exactly as it does to the training pod manager.
+
+`start_serving_fleet` is the one-call assembly used by tests and
+operators: journal into the shared serve dir, build the replica argv,
+start the manager (and optionally a policy engine) — the serving twin
+of master/main.start_master.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.master.pod_manager import (
+    LocalProcessManager,
+    _exit_reason,
+)
+from elasticdl_tpu.serving.replica_main import live_replicas
+
+logger = get_logger("serving.supervisor")
+
+
+class ServingReplicaManager(LocalProcessManager):
+    """Subprocess pod manager with replace-the-dead (not
+    restart-the-world) churn semantics."""
+
+    def _handle_churn_serialized(self, handles: List, crashed):
+        dead_ids = {h.worker_id for h, _ in crashed}
+        survivors = [h for h in handles if h.worker_id not in dead_ids]
+        for h, code in crashed:
+            logger.warning(
+                "%s died (exit %s) — replacing it (survivors keep serving)",
+                self._describe(h),
+                code,
+            )
+            self._m_relaunches.inc(reason=_exit_reason(code))
+        with self._lock:
+            self._restarts_used += 1
+            budget_left = self._restarts_used <= self._max_restarts
+            n_new = len(dead_ids) if budget_left else 0
+            new_ids = list(
+                range(self._next_worker_id, self._next_worker_id + n_new)
+            )
+            self._next_worker_id += n_new
+        obs.journal().record(
+            "worker_churn",
+            workers=sorted(dead_ids),
+            exit_codes=[code for _, code in crashed],
+            old_size=len(handles),
+            restarts_used=self._restarts_used,
+            budget_left=budget_left,
+        )
+        # Reap the dead processes (they have exited; this only closes
+        # their handles) — never the survivors.
+        self._substrate_terminate([h for h, _ in crashed])
+        new_handles = self._substrate_launch(new_ids) if new_ids else []
+        with self._lock:
+            stopped = self._stopped
+            if stopped:
+                remaining = []
+            else:
+                self._handles = survivors + new_handles
+                remaining = self._handles
+        if stopped:
+            # stop() raced the repair; don't leak the fresh replicas.
+            self._substrate_terminate(new_handles)
+            return
+        if not remaining:
+            with self._lock:
+                self._failed_reason = reason = (
+                    f"restart budget exhausted ({self._restarts_used - 1} "
+                    "used) and no serving replicas left"
+                )
+                self._stopped = True
+            logger.error("Serving fleet failed: %s", reason)
+            obs.journal().record("job_failed", reason=reason)
+            self._done_event.set()
+
+
+def replica_argv_fn(
+    model_dir: str,
+    serve_dir: str,
+    *,
+    model_zoo: str = "",
+    sparse_kernel: str = "auto",
+    max_batch_size: int = 64,
+    max_wait_us: int = 2000,
+    queue_limit: int = 256,
+    telemetry_interval_s: float = 1.0,
+    warmup_features: str = "",
+    python: str = sys.executable,
+) -> Callable[[int], List[str]]:
+    """The pod manager's `worker_argv_fn` for serving replicas: the
+    worker id IS the replica id (fresh per launch, never reused)."""
+
+    def argv(worker_id: int) -> List[str]:
+        cmd = [
+            python, "-m", "elasticdl_tpu.serving.replica_main",
+            "--model_dir", model_dir,
+            "--serve_dir", serve_dir,
+            "--replica_id", str(worker_id),
+            "--model_zoo", model_zoo,
+            "--sparse_kernel", sparse_kernel,
+            "--max_batch_size", str(max_batch_size),
+            "--max_wait_us", str(max_wait_us),
+            "--queue_limit", str(queue_limit),
+            "--telemetry_interval_s", str(telemetry_interval_s),
+        ]
+        if warmup_features:
+            cmd += ["--warmup_features", warmup_features]
+        return cmd
+
+    return argv
+
+
+def start_serving_fleet(
+    num_replicas: int,
+    model_dir: str,
+    serve_dir: str,
+    *,
+    worker_env: Optional[Dict[str, str]] = None,
+    log_dir: str = "",
+    max_restarts: int = 3,
+    policy=None,
+    **argv_kwargs,
+) -> ServingReplicaManager:
+    """Assemble and start the fleet.  `policy` (an ElasticPolicyEngine)
+    is bound to the manager and started when given."""
+    os.makedirs(serve_dir, exist_ok=True)
+    obs.init_journal(serve_dir)
+    # Replica processes must import this package no matter where the
+    # supervisor was launched from.
+    import elasticdl_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(elasticdl_tpu.__file__))
+    env = dict(worker_env or {})
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH",
+                                      os.environ.get("PYTHONPATH", "")))
+        if p
+    )
+    manager = ServingReplicaManager(
+        num_replicas,
+        replica_argv_fn(model_dir, serve_dir, **argv_kwargs),
+        worker_env=env,
+        log_dir=log_dir or os.path.join(serve_dir, "logs"),
+        max_restarts=max_restarts,
+    )
+    obs.journal().record(
+        "serving_fleet_start",
+        replicas=num_replicas,
+        model_dir=model_dir,
+        serve_dir=serve_dir,
+    )
+    manager.start()
+    if policy is not None:
+        policy.bind(manager).start()
+    return manager
+
+
+def wait_for_replicas(
+    serve_dir: str,
+    n: int,
+    timeout_s: float = 120.0,
+    poll_s: float = 0.2,
+) -> List[dict]:
+    """Block until `n` live replicas have published their ports (the
+    discovery handshake loadgen and the e2e ride)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        live = live_replicas(serve_dir)
+        if len(live) >= n:
+            return live
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"only {len(live)}/{n} serving replicas published ports "
+                f"within {timeout_s:.0f}s"
+            )
+        time.sleep(poll_s)

@@ -75,6 +75,13 @@ ALLOWED_SPREAD: Dict[str, float] = {
     # emitted tracked:false until a real multi-chip driver run; the
     # entry here is ready for the flip.
     "deepfm_train_fused_multichip_samples_per_sec_per_chip": 0.05,
+    # Staged for the serving-plane QPS row (round 13): emitted
+    # tracked:false until a driver run replaces the provisional CI-host
+    # anchor; host-side shared-core row, so the host floor applies.
+    # deepfm_serve_p99_ms deliberately has NO entry: it is
+    # lower-is-better and the ratio gate's direction would invert —
+    # it lives in UNTRACKED below instead.
+    "deepfm_serve_qps_per_replica": 0.15,
 }
 
 #: Metrics that never gate even when present (mirrors bench.py's
@@ -83,6 +90,10 @@ UNTRACKED = frozenset(
     {
         "deepfm_e2e_samples_per_sec_per_chip",
         "resnet50_e2e_images_per_sec_per_chip",
+        # Lower-is-better tail latency: the ratio gate reads shortfall
+        # as value/baseline < 1-spread, which would treat a LATENCY
+        # IMPROVEMENT as a regression — permanently report-only.
+        "deepfm_serve_p99_ms",
         "bench_backend_probe",
     }
 )

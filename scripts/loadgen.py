@@ -1,0 +1,447 @@
+#!/usr/bin/env python
+"""Deterministic load generator for the serving plane.
+
+    # open loop: paced arrivals at a target rate (the SLO-honest mode —
+    # arrival times do not depend on response times, so queueing delay
+    # is measured, not hidden)
+    python scripts/loadgen.py --serve_dir /srv/fleet --mode open \
+        --qps 200 --duration_s 30 --batch_rows 8
+
+    # closed loop: N workers issue back-to-back (throughput probe)
+    python scripts/loadgen.py --addr 127.0.0.1:40001 --mode closed \
+        --requests 500 --concurrency 4
+
+    python scripts/loadgen.py --selftest   # the `make serving-gates` gate
+
+Determinism: the request stream is seeded — request i of a run with
+seed S is the same features every time, including the hot-key skew
+(a small ``hot_fraction`` of the vocab receives ``hot_share`` of the
+categorical ids — real CTR traffic is Zipf-ish, and a cache-friendly
+uniform stream would flatter every latency number).  Replays reproduce.
+
+Targets are discovered from the serve dir (`live_replicas` — survives
+SIGKILL relaunches, replica ids are never reused) or given with
+``--addr``; multiple targets round-robin.  Output is a latency summary
+(p50/p90/p99, qps, served/shed/deadline/error counts) printed as JSON
+and optionally written with ``--output``.  tests/test_serving.py drives
+the same `run_open_loop`/`run_closed_loop` library functions in its
+acceptance e2e.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# Repo-root invocation: scripts/ is not a package.
+if __package__ in (None, ""):
+    import os as _os
+
+    sys.path.insert(
+        0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Deterministic request stream (hot-key skew)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class StreamConfig:
+    seed: int = 0
+    batch_rows: int = 8
+    vocab_size: int = 100
+    num_dense: int = 13
+    num_cat: int = 26
+    #: Fraction of the vocab that is "hot" and the share of categorical
+    #: ids drawn from it (0.1/0.8 ~ an aggressive production skew).
+    hot_fraction: float = 0.1
+    hot_share: float = 0.8
+
+
+class RequestStream:
+    """Seeded feature-dict generator: `request(i)` is a pure function of
+    (config, i), so two streams with the same config agree element-wise
+    and a failed run replays exactly."""
+
+    def __init__(self, config: StreamConfig = StreamConfig()):
+        self.config = config
+        self._n_hot = max(1, int(config.vocab_size * config.hot_fraction))
+
+    def request(self, i: int) -> Dict[str, np.ndarray]:
+        cfg = self.config
+        rng = np.random.default_rng((cfg.seed, i))
+        dense = rng.standard_normal(
+            (cfg.batch_rows, cfg.num_dense)
+        ).astype(np.float32)
+        hot = rng.random((cfg.batch_rows, cfg.num_cat)) < cfg.hot_share
+        hot_ids = rng.integers(
+            0, self._n_hot, (cfg.batch_rows, cfg.num_cat)
+        )
+        cold_ids = rng.integers(
+            self._n_hot, cfg.vocab_size, (cfg.batch_rows, cfg.num_cat)
+        )
+        cat = np.where(hot, hot_ids, cold_ids).astype(np.int32)
+        return {"dense": dense, "cat": cat}
+
+
+# ---------------------------------------------------------------------------
+# Latency accounting
+# ---------------------------------------------------------------------------
+
+OUTCOMES = ("served", "shed", "deadline", "error")
+
+
+class LatencyHistogram:
+    """Exact latency record for a bounded run (a loadgen run is minutes,
+    not days — keeping every sample beats bucket-resolution arguments)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latencies: List[float] = []  # guarded-by: _lock
+
+    def record(self, seconds: float):
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def percentile_ms(self, pct: float) -> float:
+        with self._lock:
+            lat = sorted(self._latencies)
+        if not lat:
+            return 0.0
+        rank = min(len(lat) - 1, int(round(pct / 100.0 * (len(lat) - 1))))
+        return lat[rank] * 1e3
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._latencies)
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count(),
+            "p50_ms": round(self.percentile_ms(50.0), 3),
+            "p90_ms": round(self.percentile_ms(90.0), 3),
+            "p99_ms": round(self.percentile_ms(99.0), 3),
+            "max_ms": round(self.percentile_ms(100.0), 3),
+        }
+
+
+@dataclass
+class LoadResult:
+    mode: str
+    requests: int = 0
+    outcomes: Dict[str, int] = field(
+        default_factory=lambda: {o: 0 for o in OUTCOMES}
+    )
+    elapsed_s: float = 0.0
+    histogram: LatencyHistogram = field(default_factory=LatencyHistogram)
+    #: Open loop only: requests that could not be issued on schedule
+    #: because the issuing side fell behind (loadgen saturation — a
+    #: result with nonzero lag understates server queueing).
+    schedule_lag: int = 0
+
+    def summary(self) -> dict:
+        served = self.outcomes["served"]
+        return {
+            "mode": self.mode,
+            "requests": self.requests,
+            **self.outcomes,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "qps": round(served / self.elapsed_s, 2) if self.elapsed_s else 0.0,
+            "availability_ratio": (
+                round(served / self.requests, 6) if self.requests else 1.0
+            ),
+            "schedule_lag": self.schedule_lag,
+            "latency": self.histogram.summary(),
+        }
+
+
+def classify_error(exc: BaseException) -> str:
+    """Bounded outcome from a predict failure.  gRPC status codes map
+    RESOURCE_EXHAUSTED -> shed (the server's explicit backpressure) and
+    DEADLINE_EXCEEDED -> deadline; QueueFullError/TimeoutError cover the
+    in-process path the e2e drives."""
+    code = getattr(exc, "code", None)
+    if callable(code):
+        try:
+            name = code().name
+        except Exception:
+            name = ""
+        if name == "RESOURCE_EXHAUSTED":
+            return "shed"
+        if name == "DEADLINE_EXCEEDED":
+            return "deadline"
+    if type(exc).__name__ == "QueueFullError":
+        return "shed"
+    if isinstance(exc, TimeoutError):
+        return "deadline"
+    return "error"
+
+
+# ---------------------------------------------------------------------------
+# The two loops
+# ---------------------------------------------------------------------------
+
+
+def _issue(predict_fn, stream: RequestStream, i: int, result: LoadResult,
+           lock: threading.Lock, clock=time.monotonic):
+    features = stream.request(i)
+    t0 = clock()
+    try:
+        predict_fn(features)
+        outcome = "served"
+    except Exception as exc:  # outcome-classified, never fatal
+        outcome = classify_error(exc)
+    latency = clock() - t0
+    with lock:
+        result.requests += 1
+        result.outcomes[outcome] += 1
+    if outcome == "served":
+        result.histogram.record(latency)
+
+
+def run_closed_loop(
+    predict_fn: Callable[[Dict[str, np.ndarray]], object],
+    stream: RequestStream,
+    num_requests: int,
+    concurrency: int = 1,
+    clock=time.monotonic,
+) -> LoadResult:
+    """`concurrency` workers issue back-to-back until `num_requests`
+    total have been sent.  Request indices are deterministic per worker
+    (worker w sends i = w, w+C, w+2C, ...)."""
+    result = LoadResult(mode="closed")
+    lock = threading.Lock()
+    t_start = clock()
+
+    def worker(w: int):
+        for i in range(w, num_requests, concurrency):
+            _issue(predict_fn, stream, i, result, lock, clock)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,),
+                         name=f"loadgen-closed-{w}", daemon=True)
+        for w in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    result.elapsed_s = clock() - t_start
+    return result
+
+
+def run_open_loop(
+    predict_fn: Callable[[Dict[str, np.ndarray]], object],
+    stream: RequestStream,
+    target_qps: float,
+    duration_s: float,
+    max_outstanding: int = 256,
+    clock=time.monotonic,
+    sleep=time.sleep,
+) -> LoadResult:
+    """Paced arrivals: request i is issued at t_start + i/target_qps on
+    its own thread (arrivals independent of completions).  If more than
+    `max_outstanding` requests are in flight the arrival is counted as
+    `schedule_lag` and skipped — the loadgen refuses to become an
+    unbounded thread pile when the server is saturated."""
+    if target_qps <= 0:
+        raise ValueError(f"target_qps must be > 0, got {target_qps}")
+    result = LoadResult(mode="open")
+    lock = threading.Lock()
+    outstanding = threading.Semaphore(max_outstanding)
+    threads: List[threading.Thread] = []
+    total = int(target_qps * duration_s)
+    t_start = clock()
+
+    def issue_one(i: int):
+        try:
+            _issue(predict_fn, stream, i, result, lock, clock)
+        finally:
+            outstanding.release()
+
+    for i in range(total):
+        due = t_start + i / target_qps
+        delay = due - clock()
+        if delay > 0:
+            sleep(delay)
+        if not outstanding.acquire(blocking=False):
+            with lock:
+                result.requests += 1
+                result.schedule_lag += 1
+                result.outcomes["shed"] += 1
+            continue
+        t = threading.Thread(
+            target=issue_one, args=(i,), name=f"loadgen-open-{i}",
+            daemon=True,
+        )
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    result.elapsed_s = clock() - t_start
+    return result
+
+
+def round_robin_predict(predict_fns: Sequence[Callable]) -> Callable:
+    """One predict_fn spreading requests across replicas."""
+    if not predict_fns:
+        raise ValueError("no predict targets")
+    counter = {"i": 0}
+    lock = threading.Lock()
+
+    def predict(features):
+        with lock:
+            i = counter["i"]
+            counter["i"] += 1
+        return predict_fns[i % len(predict_fns)](features)
+
+    return predict
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _selftest() -> int:
+    """No-server sanity: stream determinism + skew, outcome
+    classification, and a closed+open loop against a fake backend."""
+    cfg = StreamConfig(seed=7, batch_rows=4, vocab_size=50)
+    a, b = RequestStream(cfg), RequestStream(cfg)
+    for i in (0, 1, 99):
+        ra, rb = a.request(i), b.request(i)
+        if not (np.array_equal(ra["dense"], rb["dense"])
+                and np.array_equal(ra["cat"], rb["cat"])):
+            print("selftest FAILED: stream not deterministic",
+                  file=sys.stderr)
+            return 1
+    if np.array_equal(a.request(0)["cat"], a.request(1)["cat"]):
+        print("selftest FAILED: distinct requests identical", file=sys.stderr)
+        return 1
+    # Hot-key skew: the hot prefix of the vocab must dominate.
+    ids = np.concatenate([a.request(i)["cat"].ravel() for i in range(50)])
+    n_hot = max(1, int(cfg.vocab_size * cfg.hot_fraction))
+    hot_share = float(np.mean(ids < n_hot))
+    if not 0.6 < hot_share < 0.95:
+        print(f"selftest FAILED: hot share {hot_share}", file=sys.stderr)
+        return 1
+
+    class _Shed(Exception):
+        def code(self):
+            class _C:
+                name = "RESOURCE_EXHAUSTED"
+            return _C()
+
+    if classify_error(_Shed()) != "shed" or \
+            classify_error(TimeoutError()) != "deadline" or \
+            classify_error(RuntimeError()) != "error":
+        print("selftest FAILED: outcome classification", file=sys.stderr)
+        return 1
+
+    calls = {"n": 0}
+
+    def fake_predict(features):
+        calls["n"] += 1
+        if calls["n"] % 5 == 0:
+            raise _Shed()
+        return np.zeros(features["dense"].shape[0], np.float32)
+
+    closed = run_closed_loop(fake_predict, a, num_requests=50, concurrency=4)
+    if closed.requests != 50 or closed.outcomes["served"] != 40 \
+            or closed.outcomes["shed"] != 10:
+        print(f"selftest FAILED: closed loop {closed.summary()}",
+              file=sys.stderr)
+        return 1
+    calls["n"] = 0
+    opened = run_open_loop(fake_predict, a, target_qps=500, duration_s=0.2)
+    if opened.requests != 100 or opened.histogram.count() \
+            != opened.outcomes["served"]:
+        print(f"selftest FAILED: open loop {opened.summary()}",
+              file=sys.stderr)
+        return 1
+    summary = opened.summary()
+    if summary["latency"]["p99_ms"] < summary["latency"]["p50_ms"]:
+        print("selftest FAILED: percentile ordering", file=sys.stderr)
+        return 1
+    print("loadgen selftest OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Deterministic serving load generator."
+    )
+    parser.add_argument("--serve_dir", default="",
+                        help="discover live replicas from this serve dir")
+    parser.add_argument("--addr", action="append", default=[],
+                        help="explicit replica addr host:port (repeatable)")
+    parser.add_argument("--mode", choices=("open", "closed"), default="open")
+    parser.add_argument("--qps", type=float, default=100.0,
+                        help="open loop: target arrival rate")
+    parser.add_argument("--duration_s", type=float, default=10.0,
+                        help="open loop: run length")
+    parser.add_argument("--requests", type=int, default=200,
+                        help="closed loop: total requests")
+    parser.add_argument("--concurrency", type=int, default=4,
+                        help="closed loop: worker threads")
+    parser.add_argument("--deadline_s", type=float, default=10.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--batch_rows", type=int, default=8)
+    parser.add_argument("--vocab_size", type=int, default=100)
+    parser.add_argument("--hot_fraction", type=float, default=0.1)
+    parser.add_argument("--hot_share", type=float, default=0.8)
+    parser.add_argument("--output", default="",
+                        help="also write the JSON summary here")
+    parser.add_argument("--selftest", action="store_true")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+
+    addrs = list(args.addr)
+    if args.serve_dir:
+        from elasticdl_tpu.serving.replica_main import live_replicas
+
+        addrs += [
+            f"127.0.0.1:{r['port']}" for r in live_replicas(args.serve_dir)
+        ]
+    if not addrs:
+        print("no targets: pass --serve_dir or --addr", file=sys.stderr)
+        return 2
+
+    from elasticdl_tpu.serving.frontend import PredictClient
+
+    clients = [PredictClient(a, deadline_s=args.deadline_s) for a in addrs]
+    predict = round_robin_predict([c.predict for c in clients])
+    stream = RequestStream(StreamConfig(
+        seed=args.seed, batch_rows=args.batch_rows,
+        vocab_size=args.vocab_size, hot_fraction=args.hot_fraction,
+        hot_share=args.hot_share,
+    ))
+    if args.mode == "open":
+        result = run_open_loop(predict, stream, args.qps, args.duration_s)
+    else:
+        result = run_closed_loop(
+            predict, stream, args.requests, args.concurrency
+        )
+    summary = {"targets": addrs, **result.summary()}
+    text = json.dumps(summary, indent=2)
+    print(text)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    for c in clients:
+        c.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

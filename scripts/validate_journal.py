@@ -91,6 +91,21 @@ EVENT_REQUIRED_FIELDS = {
     # bounded metrics dump a SIGTERM'd process leaves next to its
     # flushed open spans.
     "registry_snapshot": ("reason",),
+    # Serving plane (serving/ — docs/serving.md).  `model_swap` is the
+    # hot-swap commit record (new generation + the training step it was
+    # exported at; old_generation/drained_inflight ride as optional
+    # evidence).  `request_shed` is the explicit load-shed record
+    # (reason: queue_full at admission, deadline in queue).
+    # `serving_telemetry` is the per-replica periodic rollup — replica
+    # id is unbounded, so qps/p50/p99/queue-depth/generation ride the
+    # journal, never metric labels.  Serving requests reuse
+    # `phase_transition` with the REQUEST_PHASES taxonomy
+    # (queue/batch/execute/respond — obs/stepstats.py).
+    "model_swap": ("generation", "step"),
+    "request_shed": ("reason",),
+    "serving_telemetry": ("replica_id",),
+    "serving_replica_start": ("replica_id", "port"),
+    "serving_fleet_start": ("replicas",),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -272,7 +287,27 @@ def _selftest() -> int:
          "rtt_s": 0.04},
         {"ts": 7.08, "event": "registry_snapshot", "reason": "shutdown",
          "proc": "worker_0", "metrics": {"elasticdl_rpc_calls_total": 5}},
-        {"ts": 7.1, "event": "some_future_event", "anything": "goes"},
+        # Serving plane (docs/serving.md).
+        {"ts": 7.12, "event": "model_swap", "generation": 2, "step": 4096,
+         "old_generation": 1, "old_step": 2048,
+         "model_dir": "/exports/gen2", "drained_inflight": 3,
+         "undrained": 0},
+        {"ts": 7.14, "event": "request_shed", "reason": "queue_full",
+         "queue_depth": 256, "queue_limit": 256, "rows": 8},
+        {"ts": 7.16, "event": "serving_telemetry", "replica_id": 7,
+         "generation": 2, "step": 4096, "inflight": 1, "queue_depth": 4,
+         "qps": 812.5, "p50_ms": 3.1, "p99_ms": 11.8,
+         "availability_ratio": 0.998, "served": 51233, "dropped": 14,
+         "shed": 88, "errors": 0},
+        {"ts": 7.18, "event": "serving_replica_start", "replica_id": 7,
+         "port": 40001, "model_dir": "/exports/gen2", "generation": 1},
+        {"ts": 7.2, "event": "serving_fleet_start", "replicas": 4,
+         "model_dir": "/exports/gen2", "serve_dir": "/srv/fleet"},
+        # A serving request's phase record rides the same
+        # phase_transition envelope with the REQUEST_PHASES taxonomy.
+        {"ts": 7.22, "event": "phase_transition", "from": "queue",
+         "to": "execute", "cause": "batch_formed", "seconds": 0.0021},
+        {"ts": 7.3, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
         '{"ts": 1.0, "event": "task_requeue"}',        # missing reason
@@ -284,6 +319,11 @@ def _selftest() -> int:
         '{"ts": 1.47, "event": "compile_plan", "trainer": "dp"}',  # no strategy
         '{"ts": 1.48, "event": "clock_probe", "worker_id": 0}',  # no stamps
         '{"ts": 1.49, "event": "registry_snapshot"}',           # no reason
+        '{"ts": 1.491, "event": "model_swap", "generation": 2}',  # no step
+        '{"ts": 1.492, "event": "request_shed", "rows": 8}',    # no reason
+        '{"ts": 1.493, "event": "serving_telemetry", "qps": 1}',  # no replica
+        '{"ts": 1.494, "event": "serving_replica_start", "replica_id": 1}',
+        '{"ts": 1.495, "event": "serving_fleet_start"}',        # no replicas
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

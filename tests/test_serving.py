@@ -173,3 +173,465 @@ def test_format_dict_params_round_trip():
     # ',' is genuinely non-round-trippable: it splits the item list.
     with _pytest.raises(ValueError):
         format_dict_params({"bad": "a,b"})
+
+
+# ---------------------------------------------------------------------------
+# Serving plane: micro-batcher, hot-swap runtime, elastic fleet e2e (PR 13)
+# ---------------------------------------------------------------------------
+
+import importlib.util
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.serving.batcher import (
+    BatcherConfig,
+    MicroBatcher,
+    QueueFullError,
+    RequestError,
+    bucket_for,
+    bucket_sizes,
+    pad_features,
+)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "scripts", f"{name}.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    # Registered so dataclass string annotations (`from __future__ import
+    # annotations`) can resolve against the module's namespace.
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = obs.init_journal(str(tmp_path))
+    try:
+        yield path
+    finally:
+        obs.journal().configure(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_bucket_math():
+    assert bucket_sizes(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert bucket_sizes(48) == (1, 2, 4, 8, 16, 32, 48)
+    assert bucket_for(3, (1, 2, 4, 8)) == 4
+    assert bucket_for(8, (1, 2, 4, 8)) == 8
+    feats = {"dense": np.ones((3, 2), np.float32),
+             "cat": np.ones((3, 4), np.int32)}
+    padded = pad_features(feats, 8)
+    assert padded["dense"].shape == (8, 2)
+    assert padded["cat"].dtype == np.int32
+    assert np.array_equal(padded["dense"][:3], feats["dense"])
+    assert not padded["dense"][3:].any()
+    # Exact-size arrays pass through untouched.
+    assert pad_features(feats, 3)["dense"] is feats["dense"]
+
+
+def test_batcher_size_trigger_beats_latency_budget(
+    journal_file, obs_registry_snapshot
+):
+    """The race the batcher exists to arbitrate: a FULL batch dispatches
+    immediately (long before the latency budget), while a lone request
+    dispatches at the budget (long before a full batch would form)."""
+    dispatches = []
+
+    def execute(features, n_valid):
+        rows = features["x"].shape[0]
+        dispatches.append((rows, n_valid))
+        return np.arange(rows, dtype=np.float32)
+
+    # Budget deliberately huge: only the size trigger can fire fast.
+    batcher = MicroBatcher(
+        execute,
+        BatcherConfig(max_batch_size=4, max_wait_us=2_000_000,
+                      queue_limit=16),
+    ).start()
+    try:
+        t0 = time.monotonic()
+        out = batcher.predict({"x": np.zeros((4, 1), np.float32)})
+        full_elapsed = time.monotonic() - t0
+        assert full_elapsed < 1.0, "full batch waited on the latency budget"
+        np.testing.assert_array_equal(out, np.arange(4, dtype=np.float32))
+        assert dispatches[-1] == (4, 4)
+    finally:
+        batcher.stop()
+
+    # Budget small: a lone 1-row request must NOT wait for 4 rows.
+    dispatches.clear()
+    batcher = MicroBatcher(
+        execute,
+        BatcherConfig(max_batch_size=4, max_wait_us=50_000, queue_limit=16),
+    ).start()
+    try:
+        t0 = time.monotonic()
+        out = batcher.predict({"x": np.zeros((1, 1), np.float32)})
+        lone_elapsed = time.monotonic() - t0
+        assert 0.04 <= lone_elapsed < 1.5, lone_elapsed
+        # Padded to bucket 1, one valid row, pad rows sliced off.
+        assert dispatches[-1] == (1, 1)
+        assert out.shape[0] == 1
+    finally:
+        batcher.stop()
+
+
+def test_batcher_sheds_on_full_queue(journal_file, obs_registry_snapshot):
+    """Admission past queue_limit is an immediate, journaled rejection —
+    never a silent unbounded backlog."""
+    gate = threading.Event()
+    executing = threading.Event()
+
+    def execute(features, n_valid):
+        executing.set()
+        gate.wait(timeout=30)
+        return np.zeros(features["x"].shape[0], np.float32)
+
+    shed_rows = []
+    batcher = MicroBatcher(
+        execute,
+        BatcherConfig(max_batch_size=1, max_wait_us=100, queue_limit=2),
+        on_shed=lambda rows: shed_rows.append(rows),
+    ).start()
+    try:
+        first = batcher.submit({"x": np.zeros((1, 1), np.float32)})
+        assert executing.wait(timeout=10)  # batcher thread is wedged
+        queued = [
+            batcher.submit({"x": np.zeros((1, 1), np.float32)})
+            for _ in range(2)
+        ]
+        assert batcher.queue_depth() == 2
+        with pytest.raises(QueueFullError):
+            batcher.submit({"x": np.zeros((1, 1), np.float32)})
+        assert shed_rows == [1]
+        gate.set()
+        for req in [first] + queued:
+            assert req.wait(timeout=30).shape == (1,)
+    finally:
+        gate.set()
+        batcher.stop()
+    shed = [e for e in _events(journal_file) if e["event"] == "request_shed"]
+    assert len(shed) == 1
+    assert shed[0]["reason"] == "queue_full"
+    assert shed[0]["queue_limit"] == 2
+
+
+def test_batcher_drops_expired_deadline(journal_file, obs_registry_snapshot):
+    """A request whose deadline expired while queued is dropped at
+    dispatch (its device slot would be wasted work) and the ledger
+    callback sees outcome='dropped'."""
+    gate = threading.Event()
+    executing = threading.Event()
+    outcomes = []
+
+    def execute(features, n_valid):
+        executing.set()
+        gate.wait(timeout=30)
+        return np.zeros(features["x"].shape[0], np.float32)
+
+    batcher = MicroBatcher(
+        execute,
+        BatcherConfig(max_batch_size=1, max_wait_us=100, queue_limit=8),
+        on_request=lambda phases, outcome, rows: outcomes.append(outcome),
+    ).start()
+    try:
+        batcher.submit({"x": np.zeros((1, 1), np.float32)})
+        assert executing.wait(timeout=10)
+        doomed = batcher.submit(
+            {"x": np.zeros((1, 1), np.float32)}, deadline_s=0.01
+        )
+        time.sleep(0.1)
+        gate.set()
+        with pytest.raises(RequestError, match="deadline"):
+            doomed.wait(timeout=30)
+    finally:
+        gate.set()
+        batcher.stop()
+    assert "dropped" in outcomes and "served" in outcomes
+    shed = [e for e in _events(journal_file) if e["event"] == "request_shed"]
+    assert any(e["reason"] == "deadline" for e in shed)
+
+
+def _exported_deepfm(tmp_path, steps=2):
+    """Train, export, and return (model_dir, feats, expected) where
+    expected is the trainer's mesh-jitted eval at export time."""
+    zoo, trainer, batches = _trained_deepfm(steps=steps)
+    out_dir = str(tmp_path / "gen1")
+    export_model(
+        trainer, out_dir,
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm_functional_api",
+        model_params="vocab_size=100",
+    )
+    feats, _ = batches[0]
+    feats = {k: np.asarray(v) for k, v in feats.items()}
+    return trainer, batches, out_dir, feats, np.asarray(trainer.eval_step(feats))
+
+
+def test_replica_padded_buckets_no_retrace(tmp_path, obs_registry_snapshot):
+    """After bucket warmup, live traffic of every batch size <= max
+    reuses a cached executable — the RetraceWatcher (PR 8) sees ZERO new
+    compiles across the whole size sweep."""
+    from elasticdl_tpu.obs.stepstats import RetraceWatcher
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    _, _, model_dir, feats, expected = _exported_deepfm(tmp_path)
+    replica = ServingReplica(model_dir, model_zoo="model_zoo")
+    buckets = bucket_sizes(16)
+    watcher = RetraceWatcher()
+    watcher.watch(replica.jitted_entrypoints)
+    replica.warmup({k: v[:1] for k, v in feats.items()}, buckets)
+    warm_compiles = watcher.poll().get("serve_step", 0)
+    assert warm_compiles == len(buckets)
+    full = replica.execute(feats, n_valid=16)
+    for rows in (1, 2, 3, 5, 7, 11, 16):
+        sub = {k: v[:rows] for k, v in feats.items()}
+        # Padding rows never perturb real rows: padded up to the SAME
+        # compiled shape, the sub-batch rows are BIT-identical to the
+        # full batch's (same executable, same reduction order).
+        np.testing.assert_array_equal(
+            replica.execute(pad_features(sub, 16), n_valid=rows)[:rows],
+            full[:rows],
+        )
+        # Across buckets the executable differs, so only numeric
+        # equivalence is promised (XLA reduction order per shape).
+        out = replica.execute(
+            pad_features(sub, bucket_for(rows, buckets)), n_valid=rows
+        )
+        np.testing.assert_allclose(out[:rows], full[:rows], rtol=1e-5)
+    assert watcher.poll() == {}, "padded-bucket traffic retraced"
+    np.testing.assert_allclose(
+        replica.execute(feats, n_valid=16), expected, rtol=1e-5
+    )
+
+
+def test_hot_swap_equivalence(tmp_path, journal_file, obs_registry_snapshot):
+    """Each generation's served outputs match THAT generation's trainer
+    eval; the swap is atomic (generation id bumps, old drains to zero)
+    and journaled with the schema-registered model_swap event."""
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    trainer, batches, gen1_dir, feats, expected1 = _exported_deepfm(tmp_path)
+    for f, labels in batches[2:4]:
+        trainer.train_step(f, labels)
+    gen2_dir = str(tmp_path / "gen2")
+    export_model(
+        trainer, gen2_dir,
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm_functional_api",
+        model_params="vocab_size=100",
+    )
+    expected2 = np.asarray(trainer.eval_step(feats))
+
+    replica = ServingReplica(gen1_dir, model_zoo="model_zoo")
+    assert replica.generation.gen_id == 1
+    got1 = replica.execute(feats, n_valid=16)
+    np.testing.assert_allclose(got1, expected1, rtol=1e-5)
+    # Serving determinism: repeats are bit-identical.
+    np.testing.assert_array_equal(got1, replica.execute(feats, n_valid=16))
+
+    replica.reload(gen2_dir)
+    assert replica.generation.gen_id == 2
+    got2 = replica.execute(feats, n_valid=16)
+    np.testing.assert_allclose(got2, expected2, rtol=1e-5)
+    assert not np.array_equal(got1, got2), "swap served stale weights"
+
+    swaps = [e for e in _events(journal_file) if e["event"] == "model_swap"]
+    assert len(swaps) == 1
+    assert swaps[0]["generation"] == 2
+    assert swaps[0]["old_generation"] == 1
+    assert swaps[0]["undrained"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_serving_fleet_e2e(tmp_path, obs_registry_snapshot):
+    """The ISSUE acceptance run: a supervised 2-replica fleet sustains
+    deterministic load with bounded tail latency across (a) a LIVE
+    hot-swap — zero in-flight requests dropped — and (b) a replica
+    SIGKILL the supervisor repairs with a fresh replica while the
+    survivor keeps serving.  The shared journal schema-validates."""
+    from elasticdl_tpu.serving.frontend import PredictClient, encode_features
+    from elasticdl_tpu.serving.supervisor import (
+        start_serving_fleet,
+        wait_for_replicas,
+    )
+
+    loadgen = _load_script("loadgen")
+    validator = _load_script("validate_journal")
+
+    trainer, batches, gen1_dir, feats, expected1 = _exported_deepfm(tmp_path)
+    for f, labels in batches[2:4]:
+        trainer.train_step(f, labels)
+    gen2_dir = str(tmp_path / "gen2")
+    export_model(
+        trainer, gen2_dir,
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm_functional_api",
+        model_params="vocab_size=100",
+    )
+    expected2 = np.asarray(trainer.eval_step(feats))
+
+    serve_dir = str(tmp_path / "serve")
+    os.makedirs(serve_dir)
+    warm = str(tmp_path / "warm.npz")
+    with open(warm, "wb") as fh:
+        fh.write(encode_features({k: v[:1] for k, v in feats.items()}))
+    env = {"JAX_PLATFORMS": "cpu", "ELASTICDL_FORCE_PLATFORM": "cpu"}
+    manager = start_serving_fleet(
+        2, gen1_dir, serve_dir,
+        worker_env=env,
+        model_zoo="model_zoo",
+        max_batch_size=16,
+        max_wait_us=1000,
+        telemetry_interval_s=0.5,
+        warmup_features=warm,
+    )
+    clients = {}
+    try:
+        live = wait_for_replicas(serve_dir, 2, timeout_s=300)
+        clients = {
+            r["replica_id"]: PredictClient(
+                f"127.0.0.1:{r['port']}", deadline_s=60.0
+            )
+            for r in live
+        }
+        rid_swap, rid_kill = sorted(clients)
+        # Same artifact + same compiled path: replicas agree bit-for-bit.
+        outs = [clients[rid].predict(feats) for rid in sorted(clients)]
+        np.testing.assert_array_equal(outs[0], outs[1])
+        np.testing.assert_allclose(outs[0], expected1, rtol=1e-5)
+
+        # -- (a) live hot-swap under load: zero dropped in-flight -------
+        stream = loadgen.RequestStream(loadgen.StreamConfig(seed=3))
+        predict = loadgen.round_robin_predict(
+            [clients[rid].predict for rid in sorted(clients)]
+        )
+        box = {}
+
+        def _drive():
+            box["result"] = loadgen.run_closed_loop(
+                predict, stream, num_requests=80, concurrency=4
+            )
+
+        driver = threading.Thread(
+            target=_drive, name="e2e-loadgen", daemon=True
+        )
+        driver.start()
+        time.sleep(0.5)  # swap lands mid-run, in-flight traffic live
+        swap_stats = clients[rid_swap].reload(gen2_dir)
+        assert swap_stats["generation"] == 2
+        driver.join(timeout=300)
+        result = box["result"]
+        summary = result.summary()
+        assert summary["served"] == 80, summary  # ZERO dropped/shed
+        assert summary["availability_ratio"] == 1.0, summary
+        assert 0 < summary["latency"]["p99_ms"] < 10_000, summary
+        assert summary["qps"] > 0, summary
+        # Post-swap: swapped replica serves gen2, survivor still gen1.
+        np.testing.assert_allclose(
+            clients[rid_swap].predict(feats), expected2, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            clients[rid_kill].predict(feats), expected1, rtol=1e-5
+        )
+
+        # -- (b) SIGKILL -> supervisor repairs with a FRESH replica -----
+        manager.kill_worker(rid_kill, sig=9)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            ids = manager.current_worker_ids()
+            if rid_kill not in ids and len(ids) == 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("supervisor never replaced the killed "
+                                 f"replica: {manager.current_worker_ids()}")
+        live2 = wait_for_replicas(serve_dir, 2, timeout_s=300)
+        fresh = [
+            r for r in live2 if r["replica_id"] not in (rid_swap, rid_kill)
+        ]
+        assert len(fresh) == 1, live2  # fresh id, never reused
+        fresh_client = PredictClient(
+            f"127.0.0.1:{fresh[0]['port']}", deadline_s=60.0
+        )
+        clients[fresh[0]["replica_id"]] = fresh_client
+        np.testing.assert_allclose(
+            fresh_client.predict(feats), expected1, rtol=1e-5
+        )
+        after = loadgen.run_closed_loop(
+            loadgen.round_robin_predict(
+                [clients[rid_swap].predict, fresh_client.predict]
+            ),
+            stream, num_requests=40, concurrency=4,
+        )
+        assert after.summary()["served"] == 40, after.summary()
+        stats = fresh_client.stats()
+        assert stats["ledger"]["availability_ratio"] >= 0.99, stats
+        assert stats["generation"] == 1
+    finally:
+        for client in clients.values():
+            client.close()
+        manager.stop()
+        obs.journal().configure(None)
+
+    journal_path = os.path.join(serve_dir, "events.jsonl")
+    assert validator.validate_file(journal_path) == []
+    seen = {e["event"] for e in _events(journal_path)}
+    assert {
+        "serving_fleet_start", "serving_replica_start", "serving_telemetry",
+        "model_swap", "worker_churn", "compile_plan",
+    } <= seen, seen
+
+
+def test_obs_top_serving_fold():
+    """`obs.top --serving` folds the journal tail latest-wins per replica
+    and degrades to an explicit note against training-only journals."""
+    from elasticdl_tpu.obs import top
+
+    events = [
+        {"event": "worker_telemetry", "worker_id": 0, "ts": 90.0},
+        {"event": "serving_telemetry", "replica_id": 2, "ts": 95.0,
+         "generation": 1, "step": 3, "qps": 10.0, "p50_ms": 1.0,
+         "p99_ms": 2.0, "queue_depth": 0, "inflight": 1,
+         "availability_ratio": 1.0, "served": 50, "shed": 0, "errors": 0},
+        {"event": "serving_telemetry", "replica_id": 1, "ts": 99.0,
+         "generation": 2, "step": 7, "qps": 123.4, "p50_ms": 0.5,
+         "p99_ms": 4.5, "queue_depth": 3, "inflight": 2,
+         "availability_ratio": 0.98, "served": 700, "shed": 14,
+         "errors": 0},
+        # Later snapshot for replica 2 must win over the earlier one.
+        {"event": "serving_telemetry", "replica_id": 2, "ts": 100.0,
+         "generation": 2, "step": 9, "qps": 55.0, "p50_ms": 1.1,
+         "p99_ms": 3.3, "queue_depth": 1, "inflight": 0,
+         "availability_ratio": 1.0, "served": 90, "shed": 0, "errors": 1},
+    ]
+    rows = top.serving_rows(events, now=101.0)
+    assert [r["replica"] for r in rows] == [1, 2]  # sorted by id
+    by_id = {r["replica"]: r for r in rows}
+    assert by_id[2]["generation"] == 2 and by_id[2]["served"] == 90
+    assert by_id[2]["age_s"] == 1.0
+    assert by_id[1]["availability_pct"] == "98"
+
+    frame = top.render_serving(rows, {"elasticdl_serving_qps": 178.4},
+                               addr="host:9100")
+    assert "REPLICA" in frame and "GEN" in frame and "P99(ms)" in frame
+    assert "123.4" in frame and "host:9100" in frame
+    assert "training-only" not in frame
+
+    empty = top.render_serving(top.serving_rows([{"event": "job_start"}]),
+                               {})
+    assert "training-only master" in empty
