@@ -5,8 +5,8 @@
 
 .PHONY: all proto native test test-fast test-sparse sparse-gates \
         test-compile compile-gates test-chaos test-obs test-serving \
-        serving-gates e2e bench bench-regress wheel clean lint \
-        check-invariants
+        serving-gates test-pipeline e2e bench bench-regress wheel clean \
+        lint check-invariants
 
 all: proto native test
 
@@ -66,6 +66,15 @@ test-fast: lint sparse-gates compile-gates serving-gates
 # accounting against a fake backend).
 serving-gates:
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --selftest
+
+# Standalone async-staging-engine gate (docs/design.md "Async staging
+# engine"): parse-pool ordering/determinism under jitter, prefetcher
+# backpressure + synchronous churn/checkpoint drain, overlap booking,
+# the shared serving pad-and-stage, and the sync-vs-async bit-identical
+# loss acceptance.  tests/test_pipeline.py also rides test-fast's own
+# `pytest tests/` sweep — this target is the focused entry point.
+test-pipeline:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline.py -q
 
 # Standalone serving-plane gate (docs/serving.md): export round-trip,
 # micro-batcher units (latency-budget vs batch-size race, shed-on-full,

@@ -18,6 +18,13 @@ real TPU chip), ten metrics:
 - `deepfm_e2e_host_pipeline_records_per_sec` +
   `deepfm_e2e_samples_per_sec_per_chip`: the production data-to-device
   pipeline (the coupled number is tunnel-bound here, tracked=false).
+- `deepfm_e2e_host_pipeline_async_records_per_sec` +
+  `deepfm_e2e_parse_pool_scaling_x` (round 8): the SAME host pipeline
+  through the async staging engine (data/pipeline.ParsePool fanning
+  parse_buffer over host cores at a 16 MB chunk budget), plus the
+  pool-vs-chunked-serial scaling ratio.  Both degenerate on the 1-core
+  CI host (pool of one), so they emit tracked:false until a multi-core
+  driver host records them.
 - `deepfm_26m_table_samples_per_sec_per_chip`: the north-star TABLE
   scale (26M resident rows, windowed sparse apply W=32 — the
   convergence-validated large-table config).
@@ -120,6 +127,18 @@ SELF_BASELINE = {
     # with a wide documented spread — tunnel-transfer-bound, BASELINE.md
     # "End-to-end pipeline" section).
     "deepfm_e2e_host_pipeline_records_per_sec": 990_000.0,
+    # Async staging engine (round 8, PROVISIONAL): the same host
+    # pipeline with parse_buffer fanned over data/pipeline.ParsePool at
+    # a 16 MB chunk budget.  Anchor = the sync row's recorded rate, so
+    # vs_baseline reads directly as the async-vs-sync speedup; on the
+    # 1-core CI box the pool degenerates to one worker, so the row
+    # emits tracked:false until a multi-core driver host measures it.
+    "deepfm_e2e_host_pipeline_async_records_per_sec": 990_000.0,
+    # (deepfm_e2e_parse_pool_scaling_x carries NO baseline entry on
+    # purpose: it is a ratio, not an anchored rate — 1.0 by
+    # construction on one core, permanently report-only in
+    # scripts/bench_regress.py UNTRACKED, and SELF_BASELINE's contract
+    # is "every entry has a roofline anchor" (tests/test_bench_meta.py).)
     # Tunnel-transfer-bound: observed 165k-330k across runs (H2D weather,
     # see BASELINE.md) — baseline is the observed midpoint and vs_baseline
     # swings with the recorded spread, by design.
@@ -546,6 +565,47 @@ def _bench_deepfm_e2e_body(tmp, n, batch_size, vocab, steps_per_window, repeats)
         host_times.append(time.perf_counter() - start)
     host_median, host_spread = _trimmed_median_spread(host_times, n)
 
+    # Async host pipeline (data/pipeline.py, round 8): the same file ->
+    # batch pipeline with parse_buffer fanned over a ParsePool.  The
+    # pool needs multiple chunks to overlap, so this leg caps the
+    # columnar chunk budget at 16 MB (~8 chunks for this task); the
+    # workers=0 leg re-measures the CHUNKED-serial rate so the scaling
+    # ratio compares like against like — chunk-concat overhead sits in
+    # both legs and the pool is the only variable.  Also measured
+    # before the device client exists (same stolen-core caveat as the
+    # sync row above).
+    import os
+
+    from elasticdl_tpu.data.pipeline import ParsePool
+
+    chunked_reader = zoo.CriteoRecordReader(path)
+    chunked_reader.columnar_chunk_bytes = 16 << 20
+
+    def host_pipeline_async(pool):
+        columnar = materialize_columnar_task(
+            chunked_reader, _Task, zoo.columnar_dataset_fn, "training",
+            None, parse_pool=pool,
+        )
+        return [
+            (*columnar.slice(i * batch_size, (i + 1) * batch_size), mask)
+            for i in range(steps_per_window)
+        ]
+
+    def _timed_async(pool):
+        host_pipeline_async(pool)  # warm
+        async_times = []
+        for _ in range(max(7, repeats)):
+            start = time.perf_counter()
+            host_pipeline_async(pool)
+            async_times.append(time.perf_counter() - start)
+        return _trimmed_median_spread(async_times, n)
+
+    pool_workers = max(1, os.cpu_count() or 1)
+    serial_rate, _ = _timed_async(None)
+    with ParsePool(pool_workers) as pool:
+        async_rate, async_spread = _timed_async(pool)
+    scaling_x = async_rate / serial_rate
+
     mesh = build_mesh(MeshConfig())
     trainer = ShardedEmbeddingTrainer(
         zoo.custom_model(vocab_size=vocab),
@@ -576,7 +636,11 @@ def _bench_deepfm_e2e_body(tmp, n, batch_size, vocab, steps_per_window, repeats)
     times = [run_epoch(2) for _ in range(repeats)]
     median, spread = _median_spread(times, 2 * n)
     n_chips = max(1, len(jax.devices()))
-    return (host_median, host_spread), (median / n_chips, spread)
+    return (
+        (host_median, host_spread),
+        (async_rate, async_spread, pool_workers, scaling_x),
+        (median / n_chips, spread),
+    )
 
 
 def _write_imagenet_etrf(path: str, n: int, store: int, seed: int = 0):
@@ -948,7 +1012,10 @@ def _roofline_fields(metric: str, value: float) -> dict:
             "floor_frac": round(floor_ms / value, 3),
             "bound": "host-dispatch",
         }
-    if metric == "deepfm_e2e_host_pipeline_records_per_sec":
+    if metric in (
+        "deepfm_e2e_host_pipeline_records_per_sec",
+        "deepfm_e2e_host_pipeline_async_records_per_sec",
+    ):
         return {
             "host_parse_frac": round(value / HOST_PARSE_CEILING_RPS, 3),
             "bound": "host-core",
@@ -1023,9 +1090,16 @@ def _emit(metric: str, value: float, unit: str, spread: float,
           final: bool = False, **extra):
     row = {
         "metric": metric,
-        "value": round(value, 1),
+        # Rates are O(1e3..1e6) and read fine at 1 decimal; ratio rows
+        # (parse_pool_scaling_x) are O(1) and need the precision.
+        "value": round(value, 3 if abs(value) < 10 else 1),
         "unit": unit,
-        "vs_baseline": round(value / SELF_BASELINE[metric], 3),
+        # Ratio rows (parse_pool_scaling_x) have no recorded anchor:
+        # the value IS the comparison, so vs_baseline is omitted.
+        **(
+            {"vs_baseline": round(value / SELF_BASELINE[metric], 3)}
+            if metric in SELF_BASELINE else {}
+        ),
         "spread": round(spread, 4),
         **_roofline_fields(metric, value),
         **extra,
@@ -1118,12 +1192,50 @@ def main():
         tracked=False,
         untracked_reason="tunnel-H2D-bound (same as the deepfm coupled row)",
     )
-    (host_rate, h_spread), (e2e_rate, e_spread) = bench_deepfm_e2e()
+    (
+        (host_rate, h_spread),
+        (async_rate, a_spread, pool_workers, scaling_x),
+        (e2e_rate, e_spread),
+    ) = bench_deepfm_e2e()
     _emit(
         "deepfm_e2e_host_pipeline_records_per_sec",
         host_rate,
         "records/sec/host",
         h_spread,
+        pipeline="sync",
+    )
+    # pipeline=async dimension of the same row (round 8): the shared
+    # staging engine's parse pool.  On the 1-core CI host the pool is a
+    # pool of one, so the number reads as pool OVERHEAD, not the win —
+    # the row (and its scaling companion) stays untracked until a
+    # multi-core driver host measures it; the regression gate's
+    # ALLOWED_SPREAD entry is staged for the flip.
+    _emit(
+        "deepfm_e2e_host_pipeline_async_records_per_sec",
+        async_rate,
+        "records/sec/host",
+        a_spread,
+        pipeline="async",
+        parse_workers=pool_workers,
+        tracked=False,
+        untracked_reason=(
+            "parse pool degenerates to one worker on the 1-core CI "
+            "host; provisional anchor = the sync row — flips tracked "
+            "with the first multi-core driver measurement (BASELINE.md "
+            "queued chip work)"
+        ),
+    )
+    _emit(
+        "deepfm_e2e_parse_pool_scaling_x",
+        scaling_x,
+        "x vs chunked-serial",
+        a_spread,
+        parse_workers=pool_workers,
+        tracked=False,
+        untracked_reason=(
+            "1.0 by construction on one core (scripts/bench_regress.py "
+            "keeps this row permanently report-only)"
+        ),
     )
     # The coupled number on THIS harness is bound by the tunnel's H2D
     # path (25-70 ms/MB, 3x run-to-run — BASELINE.md e2e section), so

@@ -21,6 +21,14 @@ retrace-hazard       no `jax.jit` constructed inside a loop or per-step
 donation-discipline  jitted train/window steps donate their state arg
                      (`donate_argnums`), and a donated argument is never
                      read after the donating call in the caller.
+async-staging-discipline
+                     a buffer handed to an async stager (`stage*` /
+                     `pad_and_stage`) whose staged result flows into a
+                     DONATED position of a jitted call must not be
+                     re-read by host code before that dispatch — under
+                     async dispatch the donation invalidates the buffer
+                     at an unobservable time, so the read races device
+                     reclamation.
 trace-purity         no obs registry/journal calls, file IO, or lock
                      acquisition reachable under trace — the obs plane
                      must never be traced into a step.
@@ -440,6 +448,142 @@ def _check_use_after_donate(
 
 
 # ---------------------------------------------------------------------------
+# Rule: async-staging-discipline
+# ---------------------------------------------------------------------------
+
+#: Call segments that hand a host buffer to the async staging engine
+#: (data/pipeline.py): `stage(...)`, trainer `stage_batch`/`stage_window`,
+#: and the serving-side `pad_and_stage`.
+_STAGER_NAME_RE = re.compile(r"(^|_)stage(_|$)")
+
+
+def _call_segment(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def check_async_staging_discipline(source: SourceFile) -> List[Violation]:
+    """A host buffer handed to an async stager must not be re-read before
+    the dispatch that consumes the staged result.
+
+    The hazard is specifically DONATION under async dispatch: when the
+    staged result later feeds a donated position of a jitted call, the
+    runtime reclaims the underlying buffer at a time the host cannot
+    observe (the dispatch returns before execution).  A host read of the
+    original buffer between staging and dispatch therefore races device
+    reclamation — it may see valid data in a sync run and garbage on TPU.
+    Staged results that never reach a donated position are exempt (the
+    buffer stays live), which keeps ordinary bookkeeping like
+    `len(pending)` after `stage_window(pending)` legal."""
+    index = traced_index(source)
+    donated = index.donated_callables()
+    if not donated:
+        return []
+    violations: List[Violation] = []
+    for info in index.functions.values():
+        _check_staging_in_function(source, index, info, donated, violations)
+    return violations
+
+
+def _check_staging_in_function(
+    source: SourceFile,
+    index: TracedIndex,
+    info: FunctionInfo,
+    donated: Dict[str, Tuple[int, ...]],
+    violations: List[Violation],
+):
+    # 1. Stager assignments: `staged = <...>.stage*(buf, ...)` — collect
+    #    the staged result name and the host buffer Names handed over.
+    stagers: List[Tuple[ast.Call, str, Set[str]]] = []
+    for stmt in index.own_body(info):
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        target = stmt.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        call = stmt.value
+        if not isinstance(call, ast.Call):
+            continue
+        segment = _call_segment(call)
+        if segment is None or not _STAGER_NAME_RE.search(segment):
+            continue
+        # `self`/`cls` surface from attribute-chain args
+        # (`staging.stage(self._trainer.stage_batch, batch)`) and are
+        # read by every method line — they are receivers, not buffers.
+        buffers = {
+            sub.id
+            for arg in call.args
+            for sub in ast.walk(arg)
+            if isinstance(sub, ast.Name)
+            and isinstance(sub.ctx, ast.Load)
+            and sub.id not in ("self", "cls")
+        }
+        if buffers:
+            stagers.append((call, target.id, buffers))
+    if not stagers:
+        return
+    for call, staged_name, buffers in stagers:
+        call_end = (call.end_lineno or call.lineno,
+                    call.end_col_offset or call.col_offset)
+        # 2. The downstream dispatch: first later call that passes the
+        #    STAGED RESULT at a donated position of a donating callable.
+        dispatch: Optional[ast.Call] = None
+        for node in index.own_body(info):
+            if not isinstance(node, ast.Call):
+                continue
+            start = (node.lineno, node.col_offset)
+            if start <= call_end:
+                continue
+            positions = donated.get(_call_segment(node) or "")
+            if not positions:
+                continue
+            hits_donated = any(
+                pos < len(node.args)
+                and isinstance(node.args[pos], ast.Name)
+                and node.args[pos].id == staged_name
+                for pos in positions
+            )
+            if not hits_donated:
+                continue
+            if dispatch is None or start < (dispatch.lineno,
+                                            dispatch.col_offset):
+                dispatch = node
+        if dispatch is None:
+            continue  # staged result never donated — buffer stays live
+        dispatch_start = (dispatch.lineno, dispatch.col_offset)
+        # 3. First event per handed-over buffer between stage and
+        #    dispatch: a re-bind (Store) kills the hazard for that name;
+        #    a read races reclamation.
+        for buffer in sorted(buffers):
+            events: List[Tuple[Tuple[int, int], bool, ast.Name]] = []
+            for node in index.own_body(info):
+                if isinstance(node, ast.Name) and node.id == buffer:
+                    pos = (node.lineno, node.col_offset)
+                    if call_end < pos < dispatch_start:
+                        is_store = isinstance(
+                            node.ctx, (ast.Store, ast.Del)
+                        )
+                        events.append((pos, is_store, node))
+            events.sort(key=lambda e: e[0])
+            if events and not events[0][1]:  # first event is a read
+                _, _, read = events[0]
+                violations.append(_violation(
+                    "async-staging-discipline", source, read,
+                    f"`{buffer}` is read between being handed to the "
+                    f"async stager (line {call.lineno}) and the dispatch "
+                    f"that donates the staged result (line "
+                    f"{dispatch.lineno}) — under async dispatch the "
+                    "donation reclaims the buffer at an unobservable "
+                    "time, so this read races device reclamation; read "
+                    "the buffer before staging, or keep an explicit "
+                    "host-side copy",
+                ))
+
+
+# ---------------------------------------------------------------------------
 # Rule: trace-purity
 # ---------------------------------------------------------------------------
 
@@ -614,6 +758,7 @@ JAX_RULES = {
     "jit-host-sync": check_jit_host_sync,
     "retrace-hazard": check_retrace_hazard,
     "donation-discipline": check_donation_discipline,
+    "async-staging-discipline": check_async_staging_discipline,
     "trace-purity": check_trace_purity,
     "sharding-coverage": check_sharding_coverage,
 }

@@ -80,6 +80,7 @@ def _run_local(args, mode: str):
     )
 
     from elasticdl_tpu.common.profiler import StepProfiler
+    from elasticdl_tpu.data.pipeline import PipelineConfig
 
     client = MasterClient(master.addr, worker_id=0)
     worker = Worker(
@@ -91,6 +92,7 @@ def _run_local(args, mode: str):
         profiler=StepProfiler(
             args.tensorboard_log_dir, args.profile_steps, worker_id=0
         ),
+        pipeline=PipelineConfig.from_args(args),
     )
     try:
         worker.run()

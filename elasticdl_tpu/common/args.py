@@ -158,6 +158,40 @@ def add_train_arguments(parser: argparse.ArgumentParser):
         "unmeasured code.",
     )
     parser.add_argument(
+        "--pipeline", default="sync", choices=["sync", "async"],
+        help="Step-execution pipeline (data/pipeline.py). 'sync' is the "
+        "classic serial loop (parse -> stage -> dispatch, reference "
+        "parity). 'async' overlaps the host with the device: bounded "
+        "background prefetch runs parse/batching off the step loop's "
+        "critical path, staging for window N+1 issues while window N "
+        "executes (booked as overlap_s in step anatomy, not data_wait/"
+        "stage), and a parse pool (--parse_pool_workers) fans chunk "
+        "parsing across host cores. Training results are bit-identical "
+        "to sync (tests/test_pipeline.py proves it); pipelines drain "
+        "at every task/rendezvous boundary so elastic events never see "
+        "a stale in-flight batch.",
+    )
+    parser.add_argument(
+        "--parse_pool_workers", type=non_neg_int, default=0,
+        help="Host parse-pool threads for --pipeline async (0 = parse "
+        "on the prefetch thread). numpy releases the GIL for the "
+        "columnar parse, so threads scale with physical cores; size to "
+        "~cores-2, leaving the step loop and heartbeat their own.",
+    )
+    parser.add_argument(
+        "--pipeline_inflight", type=pos_int, default=2,
+        help="--pipeline async read-ahead bound: max batches buffered "
+        "between the prefetch producer and the step loop. The "
+        "backpressure contract — a slow device stalls the producer at "
+        "this bound instead of growing host memory.",
+    )
+    parser.add_argument(
+        "--dispatch_depth", type=pos_int, default=2,
+        help="--pipeline async: how many dispatched windows are assumed "
+        "in flight on the device queue for overlap accounting (staging "
+        "issued with a dispatch outstanding books as overlap_s).",
+    )
+    parser.add_argument(
         "--oov_diagnostics", type=str2bool, nargs="?", const=True,
         default=False,
         help="Report per-step counts of embedding ids >= vocab_size in "

@@ -72,13 +72,23 @@ def materialize_columnar_task(
     columnar_dataset_fn: Optional[Callable],
     mode: str,
     metadata,
+    parse_pool=None,
 ) -> Optional[ColumnarTask]:
     """Build a ColumnarTask, or None when either side lacks the columnar
-    surface (caller falls back to the per-record dataset path)."""
+    surface (caller falls back to the per-record dataset path).  A
+    `parse_pool` (data/pipeline.ParsePool) fans chunk parsing across
+    host cores for readers that accept it — older readers without the
+    parameter are called the classic way."""
     read_columns = getattr(reader, "read_columns", None)
     if read_columns is None or columnar_dataset_fn is None:
         return None
-    chunks = list(read_columns(task))
+    if (
+        parse_pool is not None
+        and "parse_pool" in inspect.signature(read_columns).parameters
+    ):
+        chunks = list(read_columns(task, parse_pool=parse_pool))
+    else:
+        chunks = list(read_columns(task))
     if not chunks:
         return None
     if len(chunks) == 1:

@@ -410,14 +410,37 @@ class FixedWidthEtrfReader(AbstractDataReader):
             )
         return files[0]
 
-    def read_columns(self, task):
+    def record_count(self, task) -> int:
+        """Record count of one task WITHOUT materializing anything: a
+        task is a [start, end) range by contract, so the count is pure
+        arithmetic.  The parse pool's bounded read-ahead (data/
+        pipeline.py) sizes its lookahead from this instead of listing
+        an epoch's records."""
+        return max(0, int(task.end) - int(task.start))
+
+    def read_columns(self, task, parse_pool=None):
+        """Columnar chunks for one task.  With a `parse_pool`
+        (data/pipeline.ParsePool), `parse_buffer` for chunk k+1..k+n
+        runs on pool threads while the consumer transforms chunk k —
+        numpy releases the GIL for the big view-copy, so the parse
+        scales with host cores.  Ordering is deterministic either way
+        (the pool reassembles by submission index)."""
         from elasticdl_tpu.data import recordfile
 
         layout = self.layout()
-        for buf, lengths in recordfile.read_range_buffers(
+        buffers = recordfile.read_range_buffers(
             self._task_path(task), task.start, task.end,
             max_bytes=self.columnar_chunk_bytes,
-        ):
+        )
+        if parse_pool is not None and getattr(parse_pool, "workers", 0):
+            yield from parse_pool.imap(
+                lambda chunk: layout.parse_buffer(
+                    chunk[0], chunk[1], copy=self.copy_columns
+                ),
+                buffers,
+            )
+            return
+        for buf, lengths in buffers:
             yield layout.parse_buffer(
                 buf, lengths, copy=self.copy_columns
             )

@@ -16,6 +16,17 @@ class TaskDataService:
         self._dataset_fn = dataset_fn
         self._metadata = metadata if metadata is not None else data_reader.metadata
 
+    def record_count(self, task) -> int:
+        """How many records the task holds — WITHOUT materializing the
+        epoch.  A task is a [start, end) range by contract, so readers
+        that don't override `record_count` get pure arithmetic; the
+        async pipeline's bounded read-ahead (data/pipeline.Prefetcher)
+        sizes itself from this, never from a listed epoch."""
+        counter = getattr(self._reader, "record_count", None)
+        if counter is not None:
+            return int(counter(task))
+        return max(0, int(task.end) - int(task.start))
+
     def get_dataset(self, task, mode: str) -> Dataset:
         reader = self._reader
 
@@ -24,3 +35,17 @@ class TaskDataService:
 
         dataset = Dataset.from_generator(records)
         return self._dataset_fn(dataset, mode, self._metadata)
+
+    def get_batches(self, task, mode: str, batch_size: int, lookahead: int = 0):
+        """The task's minibatch iterator, optionally with BOUNDED
+        background read-ahead: `lookahead > 0` wraps the iterator in a
+        data/pipeline.Prefetcher whose queue holds at most `lookahead`
+        batches — a slow consumer (device) stalls the producer instead
+        of growing an unbounded buffer.  The caller owns the returned
+        Prefetcher's `close()` (task/rendezvous boundaries drain it)."""
+        batches = iter(self.get_dataset(task, mode).batch(batch_size))
+        if lookahead <= 0:
+            return batches
+        from elasticdl_tpu.data.pipeline import Prefetcher
+
+        return Prefetcher(batches, max_inflight=lookahead)

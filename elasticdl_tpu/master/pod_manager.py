@@ -619,6 +619,8 @@ def worker_argv_from_args(args, master_addr: str) -> Callable[[int], List[str]]:
             "output", "use_bf16", "tensorboard_log_dir", "profile_steps",
             "train_window_steps", "dense_sharding", "mesh_model_axis",
             "sparse_apply_every", "sparse_kernel",
+            "pipeline", "parse_pool_workers", "pipeline_inflight",
+            "dispatch_depth",
             "jax_compilation_cache_dir", "oov_diagnostics",
         },
     )

@@ -339,6 +339,9 @@ def _compute_attribution(events: List[dict]) -> dict:
             "bound": event.get("bound"),
             "retraces": event.get("retraces"),
             "mfu": event.get("mfu"),
+            # Async-staging credit: host seconds hidden behind device
+            # execution (outside the exclusive phase totals on purpose).
+            "overlap_s": event.get("overlap_s"),
         }
     if not workers:
         return out
@@ -416,6 +419,8 @@ def render_report(summary: dict, max_segments: int = 80) -> str:
                 extra += f", retraces: {worker['retraces']}"
             if worker.get("mfu") is not None:
                 extra += f", mfu: {worker['mfu']}"
+            if worker.get("overlap_s"):
+                extra += f", overlap: {_fmt_duration(float(worker['overlap_s']))}"
             lines.append(
                 f"  worker {wid}: dominant {dominant} "
                 f"({100 * worker['fractions'][dominant]:.0f}%{extra})"

@@ -17,6 +17,18 @@ registry, or lock call of this module ever executes under jit):
 - ``bookkeep``   — optimizer/bookkeeping host work (version reports,
   telemetry folds, checkpoint cadence decisions).
 
+One more clock rides BESIDE the exclusive phases: ``overlap_s``, the
+async staging engine's credit ledger (data/pipeline.py).  Host work
+that ran CONCURRENTLY with device execution — parse/prefetch hidden
+behind a dispatched window, ``stage_window`` issued while the previous
+window was still executing — costs no step-loop latency, so booking it
+as ``data_wait``/``stage`` would lie about the bottleneck, and dropping
+it would hide that the pipeline is doing real work.  ``overlap_s`` is
+deliberately NOT in ``PHASES``: the exclusive phase fractions still sum
+to 1.0 over wall time actually serialized on the step loop, and the
+overlap credit is reported alongside (windows, snapshot scalar,
+``obs.top``'s OV% column, ``obs.report``'s worker lines).
+
 On top of the phase clocks it keeps retrace counters keyed by jitted
 function, the device-memory high-water mark, and a per-zoo-model
 analytic FLOPs table (``MODEL_FLOPS``) that turns measured examples/s
@@ -320,8 +332,10 @@ class StepAnatomy:
         self._acc_steps = 0
         self._acc_examples = 0
         self._acc_compiles = 0
+        self._acc_overlap = 0.0
         # Job-lifetime totals.  # guarded-by: _lock
         self._totals = {p: 0.0 for p in PHASES}
+        self._overlap_total = 0.0
         self._steps_total = 0
         self._examples_total = 0
         self._windows: deque = deque(maxlen=int(max_windows))
@@ -388,6 +402,15 @@ class StepAnatomy:
         with self._lock:
             self._acc[name] += max(0.0, float(seconds))
 
+    def note_overlap_seconds(self, seconds: float) -> None:
+        """Book host seconds that ran CONCURRENTLY with device execution
+        (async staging engine credit — parse/prefetch/stage hidden
+        behind an outstanding dispatch).  Kept OUTSIDE the exclusive
+        PHASES so phase fractions keep summing to 1.0 over time actually
+        serialized on the step loop."""
+        with self._lock:
+            self._acc_overlap += max(0.0, float(seconds))
+
     @contextlib.contextmanager
     def dispatch(self, n_steps: int = 1, n_examples: int = 0):
         """Time one device dispatch; books ``compile`` when a watched
@@ -421,7 +444,7 @@ class StepAnatomy:
         the next heartbeat snapshot).  No-op when nothing accumulated."""
         with self._lock:
             accounted = sum(self._acc.values())
-            if accounted <= 0 and self._acc_steps == 0:
+            if accounted <= 0 and self._acc_steps == 0 and self._acc_overlap <= 0:
                 return None
             window = {"steps": self._acc_steps, "examples": self._acc_examples}
             for p in PHASES:
@@ -430,6 +453,9 @@ class StepAnatomy:
                 self._totals[p] += self._acc[p]
             if self._acc_compiles:
                 window["compiles"] = self._acc_compiles
+            if self._acc_overlap > 0:
+                window["overlap_s"] = round(self._acc_overlap, 6)
+            self._overlap_total += self._acc_overlap
             self._steps_total += self._acc_steps
             self._examples_total += self._acc_examples
             self._windows.append(window)
@@ -437,6 +463,7 @@ class StepAnatomy:
             self._acc_steps = 0
             self._acc_examples = 0
             self._acc_compiles = 0
+            self._acc_overlap = 0.0
             return window
 
     # -- read side ------------------------------------------------------
@@ -458,12 +485,15 @@ class StepAnatomy:
             steps = self._steps_total
             examples = self._examples_total
             model_key = self._model_key
+            overlap_total = self._overlap_total
         snap: dict = {
             "windows": windows,
             "totals": totals,
             "steps": steps,
             "examples": examples,
         }
+        if overlap_total > 0:
+            snap["overlap_s"] = round(overlap_total, 6)
         compiles = self._watcher.compiles
         if compiles:
             snap["compiles"] = {
@@ -486,7 +516,9 @@ class StepAnatomy:
 # ---------------------------------------------------------------------------
 
 _WINDOW_INT_FIELDS = ("steps", "examples", "compiles")
-_SCALAR_FLOAT_FIELDS = ("mem_hwm_mb", "mfu", "floor_frac", "bw_frac")
+_WINDOW_FLOAT_FIELDS = ("overlap_s",)  # beside the PHASES floats
+_SCALAR_FLOAT_FIELDS = ("mem_hwm_mb", "mfu", "floor_frac", "bw_frac",
+                        "overlap_s")
 _SCALAR_INT_FIELDS = ("steps", "examples", "retraces")
 MAX_WIRE_WINDOWS = 8
 
@@ -520,6 +552,10 @@ def sanitize_anatomy(anatomy) -> Optional[dict]:
                 value = _clean_number(window.get(phase))
                 if value is not None:
                     clean_window[phase] = value
+            for key in _WINDOW_FLOAT_FIELDS:
+                value = _clean_number(window.get(key))
+                if value is not None:
+                    clean_window[key] = value
             clean_windows.append(clean_window)
         clean["windows"] = clean_windows
     totals = anatomy.get("totals")

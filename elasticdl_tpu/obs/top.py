@@ -45,12 +45,16 @@ _HEADER_GAUGES = (
 _COLUMNS = (
     "WORKER", "AGE(s)", "P50(ms)", "P95(ms)", "EX/S",
     "TASK", "PROGRESS", "RDZV", "RETRY",
-    "DW%", "ST%", "CO%", "EX%", "BK%", "BOUND", "STATE",
+    "DW%", "ST%", "CO%", "EX%", "BK%", "OV%", "BOUND", "STATE",
 )
 
 #: Step-anatomy phase -> its percent column, in render order
 #: (obs/stepstats.PHASES; data_wait / stage / compile / execute /
-#: bookkeep — the per-worker phase-fraction columns).
+#: bookkeep — the per-worker phase-fraction columns).  OV% rides beside
+#: them: the async staging engine's overlap credit as a fraction of
+#: accounted-plus-overlapped host time (100% * overlap_s /
+#: (sum(totals) + overlap_s)) — how much host work the pipeline hid
+#: behind device execution.
 _PHASE_COLUMNS = ("data_wait", "stage", "compile", "execute", "bookkeep")
 
 #: Serving-plane header gauges (one replica's exporter; the table rows
@@ -187,6 +191,7 @@ def worker_rows(
                 marker = f"{marker}:{dominant}"
             state = f"STRAGGLER({marker})"
         fractions = (anatomy.get(wid) or {}).get("fractions") or {}
+        overlap = _overlap_fraction(anatomy.get(wid) or {})
         rows.append(
             {
                 "worker": wid,
@@ -202,6 +207,7 @@ def worker_rows(
                     phase: _pct(fractions.get(phase))
                     for phase in _PHASE_COLUMNS
                 },
+                "overlap": _pct(overlap),
                 "bound": (anatomy.get(wid) or {}).get("bound") or "-",
                 "state": state,
             }
@@ -320,6 +326,21 @@ def _pct(fraction) -> str:
     return f"{float(fraction) * 100:.0f}"
 
 
+def _overlap_fraction(anatomy: dict) -> Optional[float]:
+    """Async-staging overlap credit as a fraction of accounted-plus-
+    overlapped host time — None when the worker reports none (sync
+    pipeline, or a master predating overlap_s)."""
+    overlap = anatomy.get("overlap_s")
+    if not isinstance(overlap, (int, float)) or overlap <= 0:
+        return None
+    totals = anatomy.get("totals") or {}
+    accounted = sum(
+        float(v) for v in totals.values()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    )
+    return float(overlap) / (accounted + float(overlap))
+
+
 def render(
     rows: List[dict],
     metrics: Dict[str, float],
@@ -356,6 +377,7 @@ def render(
                 str(row["rendezvous_id"]),
                 str(row["retries"]),
                 *(phases.get(phase, "-") for phase in _PHASE_COLUMNS),
+                str(row.get("overlap", "-")),
                 str(row.get("bound", "-")),
                 row["state"],
             )

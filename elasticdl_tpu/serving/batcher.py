@@ -58,41 +58,16 @@ class RequestError(RuntimeError):
     """The batch this request rode failed to execute."""
 
 
-def bucket_sizes(max_batch_size: int) -> Tuple[int, ...]:
-    """Power-of-two padding buckets up to (and including) the max batch
-    size — the fixed shape set the compiled step may see."""
-    if max_batch_size < 1:
-        raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
-    sizes = []
-    size = 1
-    while size < max_batch_size:
-        sizes.append(size)
-        size *= 2
-    sizes.append(max_batch_size)
-    return tuple(sizes)
-
-
-def bucket_for(n: int, buckets: Sequence[int]) -> int:
-    """The smallest bucket holding n rows."""
-    for size in buckets:
-        if n <= size:
-            return size
-    return buckets[-1]
-
-
-def pad_features(features: Dict[str, np.ndarray], rows: int) -> Dict[str, np.ndarray]:
-    """Zero-pad every array of a features dict to `rows` along axis 0.
-    Id 0 is a valid embedding row, but pad rows' outputs are sliced off
-    before any request sees them and model rows are independent."""
-    out = {}
-    for key, array in features.items():
-        array = np.asarray(array)
-        if array.shape[0] == rows:
-            out[key] = array
-            continue
-        pad = np.zeros((rows - array.shape[0],) + array.shape[1:], array.dtype)
-        out[key] = np.concatenate([array, pad], axis=0)
-    return out
+# Pad-and-stage is the shared staging engine's (data/pipeline.py) —
+# training and serving use ONE implementation.  Re-exported here because
+# the serving plane's callers (runtime, tests) import them from the
+# batcher, the serving-side name for the same step.
+from elasticdl_tpu.data.pipeline import (  # noqa: F401  (re-exports)
+    bucket_for,
+    bucket_sizes,
+    pad_and_stage,
+    pad_features,
+)
 
 
 @dataclass(eq=False)  # identity semantics: fields hold numpy arrays
@@ -324,7 +299,7 @@ class MicroBatcher:
             )
             for key in live[0].features
         }
-        padded = pad_features(stacked, bucket_for(rows, self._buckets))
+        padded, _ = pad_and_stage(stacked, rows, self._buckets)
         t_exec = self._clock()
         batch_s = t_exec - t_batch
         self._m_batch_rows.observe(float(rows))

@@ -31,6 +31,12 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
 from elasticdl_tpu.data.columnar import materialize_columnar_task
 from elasticdl_tpu.data.dataset import Dataset, SequentialRecords, _stack
+from elasticdl_tpu.data.pipeline import (
+    ParsePool,
+    PipelineConfig,
+    Prefetcher,
+    StagingPipeline,
+)
 from elasticdl_tpu.obs import goodput, tracing
 from elasticdl_tpu.parallel import elastic
 from elasticdl_tpu.parallel import sharding as shd
@@ -61,6 +67,7 @@ class CollectiveWorker:
         train_window_steps: int = 0,
         telemetry=None,
         anatomy=None,
+        pipeline: Optional[PipelineConfig] = None,
     ):
         self._mc = master_client
         self._spec = model_spec
@@ -95,6 +102,19 @@ class CollectiveWorker:
         # measured optimum, the task size, and a staged-bytes cap — see
         # _window_candidate).
         self._window_steps = int(train_window_steps)
+        # Async staging engine (data/pipeline.py, --pipeline async):
+        # bounded background prefetch + parse pool off the step loop's
+        # critical path, staging booked as overlap credit while a
+        # dispatch is outstanding.  Sync (the default) is byte-identical
+        # to the classic serial loop.  The parse pool is process-long
+        # (threads are reused across tasks; per-imap state drains with
+        # each task, and churn kills the whole process anyway).
+        self._pipeline = pipeline or PipelineConfig()
+        self._parse_pool = (
+            ParsePool(self._pipeline.parse_workers)
+            if self._pipeline.is_async and self._pipeline.parse_workers > 0
+            else None
+        )
         self._batch_nbytes: Optional[int] = None
         self._apply_short_warned = False
         # The windowed sparse apply (ps_trainer sparse_apply_every) chunks
@@ -383,6 +403,7 @@ class CollectiveWorker:
             getattr(self._spec, "columnar_dataset_fn", None),
             mode,
             self._metadata,
+            parse_pool=self._parse_pool,
         )
         if columnar is not None and not self._columnar_logged:
             # e2e tests grep this to prove the vectorized path engaged.
@@ -533,6 +554,23 @@ class CollectiveWorker:
                     task_batches,
                 )
         window_steps = self._effective_window
+        # Async mode: staging books as overlap credit while a dispatch
+        # is outstanding (double-buffering — window N+1 stages while N
+        # executes); sync mode books the classic exclusive phase.
+        staging = (
+            StagingPipeline(self._anatomy, self._pipeline.dispatch_depth)
+            if self._pipeline.is_async
+            else None
+        )
+        # Prefetcher overlap already credited to the anatomy (cumulative
+        # marker: overlap_s on the prefetcher only ever grows).
+        overlap_booked = [0.0]
+
+        def stage_call(fn, *args):
+            if staging is not None:
+                return staging.stage(fn, *args)
+            with self._anat_phase("stage"):
+                return fn(*args)
 
         def flush():
             nonlocal batch_count, record_count, pending, pending_real, last_loss
@@ -548,19 +586,23 @@ class CollectiveWorker:
             if len(pending) == window_steps and hasattr(
                 self._trainer, "stage_window"
             ):
-                with self._anat_phase("stage"):
-                    window = self._trainer.stage_window(pending)
+                window = stage_call(self._trainer.stage_window, pending)
                 with self._anat_dispatch(len(pending), pending_real):
                     losses = self._trainer.train_window(window)
+                if staging is not None:
+                    staging.note_dispatched()
                 last_loss = losses[-1]
             else:
                 for i, staged_batch in enumerate(pending):
-                    with self._anat_phase("stage"):
-                        staged = self._trainer.stage_batch(*staged_batch)
+                    staged = stage_call(
+                        self._trainer.stage_batch, *staged_batch
+                    )
                     # Real-record count is per-flush, not per-step:
                     # credit it once so the window's examples are exact.
                     with self._anat_dispatch(1, pending_real if i == 0 else 0):
                         last_loss = self._trainer.train_step_staged(staged)
+                    if staging is not None:
+                        staging.note_dispatched()
             with self._anat_phase("bookkeep"):
                 if self._telemetry is not None:
                     # One telemetry sample per dispatch (not per step):
@@ -579,6 +621,16 @@ class CollectiveWorker:
                 self._report_version_if_due()
                 self._maybe_checkpoint()
             if self._anatomy is not None:
+                if prefetcher is not None:
+                    # Producer time hidden behind this flush's device
+                    # work: credit the delta since the last flush so
+                    # each anatomy window carries its own overlap.
+                    produced = prefetcher.overlap_s
+                    if produced > overlap_booked[0]:
+                        self._anatomy.note_overlap_seconds(
+                            produced - overlap_booked[0]
+                        )
+                        overlap_booked[0] = produced
                 # One anatomy window per dispatch flush: the unit the
                 # heartbeat snapshot summarizes — and one aggregate
                 # child span per phase under the open worker.task span
@@ -588,55 +640,78 @@ class CollectiveWorker:
                     tracing.tracer().record_window_spans(window)
 
         batches = self._local_batches(task, Mode.TRAINING)
-        while True:
-            # Host data wait: read + parse + batch assembly (and padding)
-            # happen inside the generator — the starvation signal the
-            # step anatomy exists to expose.
-            with self._anat_phase("data_wait"):
-                item = next(batches, None)
-            if item is None:
-                break
-            features, labels, mask, global_real = item
-            if self._trainer.state is None:
-                # First touch: model init + eval_shape + jit build is
-                # compile-plane time, not execute.
-                with self._anat_phase("compile"):
+        prefetcher = None
+        if self._pipeline.is_async:
+            # Bounded background read-ahead: parse + batch assembly for
+            # item N+1..N+k runs off the critical path while N's window
+            # dispatches.  data_wait below then measures only the time
+            # the step loop truly BLOCKED; the producer time it hid is
+            # credited as overlap at each flush.
+            prefetcher = Prefetcher(
+                batches, max_inflight=self._pipeline.max_inflight
+            )
+            batches = prefetcher
+        try:
+            while True:
+                # Host data wait: read + parse + batch assembly (and
+                # padding) happen inside the generator (or behind the
+                # prefetcher) — the starvation signal the step anatomy
+                # exists to expose.
+                with self._anat_phase("data_wait"):
+                    item = next(batches, None)
+                if item is None:
+                    break
+                features, labels, mask, global_real = item
+                if self._trainer.state is None:
+                    # First touch: model init + eval_shape + jit build is
+                    # compile-plane time, not execute.
+                    with self._anat_phase("compile"):
+                        self._trainer.ensure_initialized(features)
+                else:
                     self._trainer.ensure_initialized(features)
-            else:
-                self._trainer.ensure_initialized(features)
-            if self._batch_nbytes is None:
-                # One-time refinement of the window from the real
-                # staged-batch size AND the trainer's now-resolved apply
-                # interval (--sparse_apply_every=auto resolves at init),
-                # before anything has compiled.  Byte refinement only
-                # shrinks; an auto-resolved interval may also GROW an
-                # explicit window to a chunk multiple.
-                apply_changed = self._sync_apply_every()
-                self._batch_nbytes = sum(
-                    np.asarray(leaf).nbytes
-                    for leaf in jax.tree.leaves((features, labels, mask))
-                )
-                refined = self._window_candidate(task_batches)
-                if refined < window_steps or (
-                    apply_changed and refined != window_steps
-                ):
-                    if self._world.is_leader:
-                        logger.info(
-                            "Dispatch window %d -> %d (staged batch is "
-                            "%.1f MB, %d MB auto cap; "
-                            "sparse_apply_every=%d)",
-                            window_steps, refined,
-                            self._batch_nbytes / 2**20,
-                            self.AUTO_WINDOW_BYTES >> 20,
-                            self._apply_every,
-                        )
-                    window_steps = refined
-                    self._effective_window = refined
-            pending.append((features, labels, mask))
-            pending_real += global_real
-            if len(pending) == window_steps:
-                flush()
-        flush()
+                if self._batch_nbytes is None:
+                    # One-time refinement of the window from the real
+                    # staged-batch size AND the trainer's now-resolved
+                    # apply interval (--sparse_apply_every=auto resolves
+                    # at init), before anything has compiled.  Byte
+                    # refinement only shrinks; an auto-resolved interval
+                    # may also GROW an explicit window to a chunk
+                    # multiple.
+                    apply_changed = self._sync_apply_every()
+                    self._batch_nbytes = sum(
+                        np.asarray(leaf).nbytes
+                        for leaf in jax.tree.leaves((features, labels, mask))
+                    )
+                    refined = self._window_candidate(task_batches)
+                    if refined < window_steps or (
+                        apply_changed and refined != window_steps
+                    ):
+                        if self._world.is_leader:
+                            logger.info(
+                                "Dispatch window %d -> %d (staged batch is "
+                                "%.1f MB, %d MB auto cap; "
+                                "sparse_apply_every=%d)",
+                                window_steps, refined,
+                                self._batch_nbytes / 2**20,
+                                self.AUTO_WINDOW_BYTES >> 20,
+                                self._apply_every,
+                            )
+                        window_steps = refined
+                        self._effective_window = refined
+                pending.append((features, labels, mask))
+                pending_real += global_real
+                if len(pending) == window_steps:
+                    flush()
+            flush()
+        finally:
+            # Task boundary (normal end, checkpoint cadence handled in
+            # flush, or an exception about to re-form the world): drain
+            # synchronously so no stale in-flight batch ever crosses a
+            # rendezvous generation.
+            if prefetcher is not None:
+                prefetcher.close()
+            if staging is not None:
+                staging.drain()
         if last_loss is not None and self._world.is_leader:
             logger.info(
                 "task %d done: step=%d loss=%.5f (%d global batches)",

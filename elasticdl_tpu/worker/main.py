@@ -115,6 +115,8 @@ def main(argv=None):
         from elasticdl_tpu.common.profiler import StepProfiler
         from elasticdl_tpu.obs.stepstats import StepAnatomy
 
+        from elasticdl_tpu.data.pipeline import PipelineConfig
+
         anatomy = StepAnatomy(args.worker_id)
         anatomy.set_model(
             getattr(args, "model_def", "") or getattr(args, "model_zoo", "")
@@ -130,6 +132,7 @@ def main(argv=None):
                 args.tensorboard_log_dir, args.profile_steps, args.worker_id
             ),
             anatomy=anatomy,
+            pipeline=PipelineConfig.from_args(args),
         )
     worker.run()
     if args.output and "training" in args.job_type:
@@ -256,7 +259,14 @@ def _build_collective_worker(
         ),
         train_window_steps=args.train_window_steps,
         telemetry=telemetry,
+        pipeline=_pipeline_config(args),
     )
+
+
+def _pipeline_config(args):
+    from elasticdl_tpu.data.pipeline import PipelineConfig
+
+    return PipelineConfig.from_args(args)
 
 
 if __name__ == "__main__":

@@ -20,6 +20,7 @@ from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.constants import Mode, TaskExecCounterKey
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
+from elasticdl_tpu.data.pipeline import PipelineConfig, Prefetcher
 from elasticdl_tpu.data.task_data_service import TaskDataService
 from elasticdl_tpu.obs import goodput
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -43,6 +44,7 @@ class Worker:
         prediction_data_reader=None,
         profiler=None,
         anatomy=None,
+        pipeline: Optional[PipelineConfig] = None,
     ):
         self._mc = master_client
         self._spec = model_spec
@@ -80,6 +82,12 @@ class Worker:
             self._trainer, "jitted_entrypoints"
         ):
             anatomy.watch_jits(self._trainer.jitted_entrypoints)
+        # Async staging engine (data/pipeline.py): Local mode fuses
+        # staging into train_step, so async here means bounded
+        # background prefetch — parse/batching for item N+1 runs while
+        # step N dispatches, with the hidden producer time credited as
+        # anatomy overlap.  Sync (default) is the classic serial loop.
+        self._pipeline = pipeline or PipelineConfig()
 
     def _anat_phase(self, name: str):
         if self._anatomy is None:
@@ -183,40 +191,57 @@ class Worker:
         return dataset.batch(self._minibatch_size)
 
     def _process_train_task(self, task) -> dict:
-        dataset = self._get_batches(task, Mode.TRAINING)
         batch_count = 0
         record_count = 0
         last_loss = None
-        batches = iter(dataset)
-        while True:
-            # Host data wait: record parse + batching live in the
-            # iterator (step anatomy's starvation signal).
-            with self._anat_phase("data_wait"):
-                batch = next(batches, None)
-            if batch is None:
-                break
-            features, labels = batch
-            spec = faults.fire("worker.step")
-            if spec is not None and spec.kind == "crash":
-                faults.crash_now(spec)
-            if self._profiler is not None:
-                self._profiler.before_steps(self._trainer.step)
-            n = _batch_size_of(features)
-            if self._anatomy is not None:
-                # One dispatch per batch in Local mode (staging is fused
-                # into train_step; compile-vs-execute split comes from
-                # the trainer's watched jit cache).
-                with self._anatomy.dispatch(1, n):
-                    last_loss = self._trainer.train_step(features, labels)
-            else:
-                last_loss = self._trainer.train_step(features, labels)
-            batch_count += 1
-            record_count += n
-            with self._anat_phase("bookkeep"):
+        prefetcher = None
+        if self._pipeline.is_async:
+            batches = self._task_data_service.get_batches(
+                task, Mode.TRAINING, self._minibatch_size,
+                lookahead=self._pipeline.max_inflight,
+            )
+            if isinstance(batches, Prefetcher):
+                prefetcher = batches
+        else:
+            batches = iter(self._get_batches(task, Mode.TRAINING))
+        try:
+            while True:
+                # Host data wait: record parse + batching live in the
+                # iterator (step anatomy's starvation signal); behind a
+                # prefetcher this measures only true blocked time.
+                with self._anat_phase("data_wait"):
+                    batch = next(batches, None)
+                if batch is None:
+                    break
+                features, labels = batch
+                spec = faults.fire("worker.step")
+                if spec is not None and spec.kind == "crash":
+                    faults.crash_now(spec)
                 if self._profiler is not None:
-                    self._profiler.after_steps(self._trainer.step)
-                if self._trainer.step % self._report_every == 0:
-                    self._report_version()
+                    self._profiler.before_steps(self._trainer.step)
+                n = _batch_size_of(features)
+                if self._anatomy is not None:
+                    # One dispatch per batch in Local mode (staging is
+                    # fused into train_step; compile-vs-execute split
+                    # comes from the trainer's watched jit cache).
+                    with self._anatomy.dispatch(1, n):
+                        last_loss = self._trainer.train_step(features, labels)
+                else:
+                    last_loss = self._trainer.train_step(features, labels)
+                batch_count += 1
+                record_count += n
+                with self._anat_phase("bookkeep"):
+                    if self._profiler is not None:
+                        self._profiler.after_steps(self._trainer.step)
+                    if self._trainer.step % self._report_every == 0:
+                        self._report_version()
+        finally:
+            # Task boundary (or an exception): drain the read-ahead so
+            # no stale in-flight batch survives into the next task.
+            if prefetcher is not None:
+                if self._anatomy is not None:
+                    self._anatomy.note_overlap_seconds(prefetcher.overlap_s)
+                prefetcher.close()
         if self._anatomy is not None:
             # One anatomy window per task in Local mode — and since this
             # path has no telemetry heartbeat to carry it, journal the

@@ -62,6 +62,12 @@ ALLOWED_SPREAD: Dict[str, float] = {
     # Host-side rows: BASELINE.md records 60 % outlier windows on the
     # shared core (trimmed to ~2-15 % spread); gate at 15 %.
     "deepfm_e2e_host_pipeline_records_per_sec": 0.15,
+    # Staged for the async staging engine row (round 8): emitted
+    # tracked:false until a multi-core driver host replaces the
+    # provisional sync-row anchor (on the 1-core CI box the parse pool
+    # degenerates to one worker); host-side shared-core row, so the
+    # host floor applies once it flips tracked.
+    "deepfm_e2e_host_pipeline_async_records_per_sec": 0.15,
     "resnet50_e2e_host_pipeline_images_per_sec": 0.15,
     # 26M-row table rows recorded at 0.5-1.0 % spread; 5 % floor.
     "deepfm_26m_table_samples_per_sec_per_chip": 0.05,
@@ -89,6 +95,11 @@ ALLOWED_SPREAD: Dict[str, float] = {
 UNTRACKED = frozenset(
     {
         "deepfm_e2e_samples_per_sec_per_chip",
+        # Parse-pool scaling ratio: 1.0 by construction on the 1-core
+        # CI host, so the ratio gate would be noise-gating the pool's
+        # fixed overhead — permanently report-only; the async RATE row
+        # above is the one that flips tracked with driver evidence.
+        "deepfm_e2e_parse_pool_scaling_x",
         "resnet50_e2e_images_per_sec_per_chip",
         # Lower-is-better tail latency: the ratio gate reads shortfall
         # as value/baseline < 1-spread, which would treat a LATENCY

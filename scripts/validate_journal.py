@@ -255,11 +255,14 @@ def _selftest() -> int:
         {"ts": 6.8, "event": "policy_decision", "action": "evict",
          "reason": "persistent_straggler", "worker_id": 1,
          "flag_streak_ticks": 3, "kill_budget_remaining": 0},
+        # overlap_s rides BESIDE the exclusive phase totals (async
+        # staging credit, obs/stepstats.py): fractions still sum to 1.0
+        # over serialized time and overlap_s reports the hidden work.
         {"ts": 6.85, "event": "step_anatomy", "worker_id": 0,
          "totals": {"data_wait": 1.2, "execute": 4.0}, "steps": 64,
          "examples": 4096, "retraces": 1, "bound": "host",
          "fractions": {"data_wait": 0.23, "execute": 0.77},
-         "dominant_phase": "execute"},
+         "dominant_phase": "execute", "overlap_s": 0.8},
         {"ts": 6.9, "event": "profile_window", "worker_id": 2,
          "action": "open", "step_start": 100, "step_end": 120,
          "trace_dir": "/logs/job1/profile/worker_2"},

@@ -710,6 +710,82 @@ def test_donation_accepts_donating_steps_and_undonated_eval():
     assert found == []
 
 
+def test_async_staging_flags_buffer_read_before_donating_dispatch():
+    found = violations(
+        """
+        import jax
+
+        class T:
+            def __init__(self):
+                self._train_step = jax.jit(
+                    self._train_step_impl, donate_argnums=(0,)
+                )
+
+            def _train_step_impl(self, staged, rows):
+                return staged
+
+            def run(self, staging, batch):
+                staged = staging.stage_batch(batch)
+                rows = len(batch)
+                return self._train_step(staged, rows)
+        """,
+        "async-staging-discipline",
+    )
+    assert len(found) == 1
+    assert "batch" in found[0].message and "reclamation" in found[0].message
+
+
+def test_async_staging_accepts_undonated_result_and_rebind():
+    # Staged result feeds a NON-donated position (the repo's own
+    # `len(pending)` after `stage_window(pending)` shape) — the buffer
+    # stays live, bookkeeping reads are fine.
+    found = violations(
+        """
+        import jax
+
+        class T:
+            def __init__(self):
+                self._train_step = jax.jit(
+                    self._train_step_impl, donate_argnums=(0,)
+                )
+
+            def _train_step_impl(self, state, window):
+                return state, 0.0
+
+            def run(self, staging, state, pending):
+                window = staging.stage_window(pending)
+                count = len(pending)
+                state, loss = self._train_step(state, window)
+                return count
+        """,
+        "async-staging-discipline",
+    )
+    assert found == []
+    # A re-bind of the buffer name between stage and dispatch kills the
+    # hazard (the read would see the new binding, not the donated one).
+    found = violations(
+        """
+        import jax
+
+        class T:
+            def __init__(self):
+                self._train_step = jax.jit(
+                    self._train_step_impl, donate_argnums=(0,)
+                )
+
+            def _train_step_impl(self, staged, rows):
+                return staged
+
+            def run(self, staging, batch):
+                staged = staging.stage_batch(batch)
+                batch = self._next()
+                return self._train_step(staged, len(batch))
+        """,
+        "async-staging-discipline",
+    )
+    assert found == []
+
+
 def test_trace_purity_flags_obs_io_and_locks_under_trace():
     found = violations(
         """
@@ -908,6 +984,20 @@ _SEEDED_VIOLATIONS = {
         "        self._train_step = jax.jit(self._train_step_impl)\n"
         "    def _train_step_impl(self, state, batch):\n"
         "        return state\n"
+    ),
+    "async-staging-discipline": (
+        "import jax\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._train_step = jax.jit(\n"
+        "            self._impl, donate_argnums=(0,)\n"
+        "        )\n"
+        "    def _impl(self, staged, rows):\n"
+        "        return staged\n"
+        "    def run(self, staging, batch):\n"
+        "        staged = staging.stage_batch(batch)\n"
+        "        rows = len(batch)\n"
+        "        return self._train_step(staged, rows)\n"
     ),
     "trace-purity": (
         "import jax\n"
