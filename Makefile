@@ -5,8 +5,8 @@
 
 .PHONY: all proto native test test-fast test-sparse sparse-gates \
         test-compile compile-gates test-chaos test-obs test-serving \
-        serving-gates test-pipeline e2e bench bench-regress wheel clean \
-        lint check-invariants
+        serving-gates test-pipeline test-stream stream-gates e2e bench \
+        bench-regress wheel clean lint check-invariants
 
 all: proto native test
 
@@ -57,8 +57,25 @@ lint:
 # test-fast's own `pytest tests/` sweep, so chaining the full
 # test-sparse / test-compile targets would run them twice per tier-1
 # pass.
-test-fast: lint sparse-gates compile-gates serving-gates
+test-fast: lint sparse-gates compile-gates serving-gates stream-gates
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# Script gate of the continuous train->serve loop, shared by
+# test-stream and test-fast: the freshness SLO tracker's deterministic
+# breach/clear transition selftest (one journal event per transition).
+stream-gates:
+	JAX_PLATFORMS=cpu python -m elasticdl_tpu.obs.freshness --selftest
+
+# Standalone continuous-loop gate (docs/design.md "Continuous
+# training"): the streaming dispatcher (watermark eviction, bounded
+# lookahead, both crash-resume paths), the synthetic click stream's
+# virtual-clock schedule math, and the delta-checkpoint chain
+# (diff publish, torn-write quarantine, compaction repair, serving-side
+# row-patch apply with atomic rollback).  The chaos acceptance e2e
+# (tests/test_stream_e2e.py) is `slow`-marked and rides test-chaos.
+test-stream: stream-gates
+	JAX_PLATFORMS=cpu python -m pytest tests/test_stream.py \
+	       tests/test_delta.py -q
 
 # Script gate of the serving plane, shared by test-serving and
 # test-fast: the load generator's no-server selftest (stream
@@ -147,11 +164,14 @@ test-obs:
 # (common/faults.py, incl. the schedule-based @t storm triggers), the
 # master-SIGKILL / torn-checkpoint chaos e2es, the preemption-storm
 # two-baseline e2e (the policy engine must beat fixed-size AND naive
-# always-rescale on the goodput ledger's own accounting), and the
-# policy-enforcement units.
+# always-rescale on the goodput ledger's own accounting), the
+# policy-enforcement units, and the continuous train->serve chaos
+# acceptance (stream spike + source stall + worker churn + master
+# SIGKILL + torn delta + failed apply, under live loadgen traffic).
 test-chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
-	       tests/test_faults.py tests/test_policy.py -q
+	       tests/test_faults.py tests/test_policy.py \
+	       tests/test_stream_e2e.py -q
 
 # The real multi-process end-to-end slices only (elasticity, PS, k8s).
 e2e:

@@ -3,3 +3,8 @@ from elasticdl_tpu.checkpoint.sharded import (  # noqa: F401
     RowReader,
     ShardedCheckpointSaver,
 )
+from elasticdl_tpu.checkpoint.delta import (  # noqa: F401
+    DeltaExporter,
+    load_delta,
+    resolve_chain,
+)

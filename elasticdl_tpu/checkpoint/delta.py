@@ -1,0 +1,518 @@
+"""Incremental (delta) sparse checkpoints for the continuous serve loop.
+
+A full serving artifact (serving/export.py) snapshots every embedding
+row; between publishes only the rows the optimizer actually touched
+change (`fused_dedup_apply` materializes exactly that set — the
+diff-based export below recovers it from the packed tables, which keeps
+this module decoupled from the trainer's apply internals while producing
+the identical row set).  A *delta* therefore carries:
+
+    <pub_dir>/delta_<base_step>_<step>/
+      delta.json       - chain link: base_step -> step, event_time,
+                         per-table changed-row inventory
+      dense.pkl        - the FULL dense variables tree (small next to the
+                         tables; embedding leaves stay {"__table__": ...}
+                         references, resolved by the consumer against its
+                         patched tables)
+      rows_<i>.npy     - int64 changed packed-row indices for table i
+      vals_<i>.npy     - the new packed rows, same order
+      integrity.json   - CRC32 manifest over ALL of the above, written
+                         BEFORE the atomic commit rename (same torn-write
+                         discipline as full checkpoints)
+
+Fulls live beside deltas (`full_<step>/`, a plain serving artifact plus
+the same integrity manifest), forming a chain:
+
+    full_100 <- delta_100_120 <- delta_120_140 <- ...
+
+`resolve_chain` walks it newest-full-first, QUARANTINES any link that
+fails its manifest (renamed aside — forensic evidence, never deleted —
+and journaled `checkpoint_quarantined`), and stops the chain at the
+first gap: the consumer falls back to what survives, stale but correct.
+Periodic compaction folds the exporter's head back into a fresh full,
+which both bounds chain length and REPAIRS a quarantine gap — the
+degradation is always temporary.
+
+Fault site: `ckpt.delta` (`truncate` kind) tears the largest delta file
+AFTER its checksum is recorded — the exact corruption a crashed flush
+publishes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.checkpoint.saver import (
+    _ckpt_metrics,
+    verify_integrity,
+    write_integrity_manifest,
+)
+
+logger = get_logger("checkpoint.delta")
+
+DELTA_FORMAT = "elasticdl_tpu_delta/1"
+DELTA_MANIFEST = "delta.json"
+_DENSE_FILE = "dense.pkl"
+_QUARANTINE_SUFFIX = ".quarantined"
+
+
+def _full_name(step: int) -> str:
+    return f"full_{step:012d}"
+
+
+def _delta_name(base_step: int, step: int) -> str:
+    return f"delta_{base_step:012d}_{step:012d}"
+
+
+def quarantine_artifact(path: str, reason: str) -> str:
+    """Move a corrupt full/delta aside (same discipline as
+    CheckpointSaver._quarantine: evidence is never deleted, the journal
+    carries the reason, and no future chain walk can pick it again)."""
+    target = path + _QUARANTINE_SUFFIX
+    n = 2
+    while os.path.exists(target):
+        target = f"{path}{_QUARANTINE_SUFFIX}.{n}"
+        n += 1
+    logger.error(
+        "Quarantining corrupt artifact %s -> %s (%s)", path, target, reason
+    )
+    _save, _restore, _saves, quarantines = _ckpt_metrics()
+    quarantines.inc()
+    obs.journal().record("checkpoint_quarantined", path=path, reason=reason)
+    try:
+        os.rename(path, target)
+    except OSError:
+        logger.exception("Quarantine rename failed for %s", path)
+    return target
+
+
+def _apply_delta_write_fault(tmp_dir: str, filenames: List[str]) -> None:
+    """The `ckpt.delta` injection site: tear the largest inventoried file
+    after the manifest recorded its checksum (mirrors saver's
+    `_apply_write_fault` for full checkpoints)."""
+    spec = faults.fire("ckpt.delta")
+    if spec is None or spec.kind != "truncate":
+        return
+    target = max(
+        (os.path.join(tmp_dir, name) for name in filenames),
+        key=os.path.getsize,
+    )
+    size = os.path.getsize(target)
+    keep = int(spec.arg) if spec.arg else size // 2
+    with open(target, "r+b") as f:
+        f.truncate(keep)
+    logger.warning(
+        "FAULT INJECTION: truncated delta file %s to %d of %d bytes",
+        target, keep, size,
+    )
+
+
+class DeltaExporter:
+    """Publishes the full/delta chain for one trainer into `pub_dir`.
+
+    Holds the last-published packed tables in host memory (the *head*)
+    so each delta is a pure array diff — no trainer-internals coupling.
+    Head memory equals one model's table footprint, the same bound the
+    export path itself already pays.
+    """
+
+    def __init__(
+        self,
+        pub_dir: str,
+        model_zoo: str = "",
+        model_def: str = "",
+        model_params: str = "",
+        keep_fulls: int = 2,
+    ):
+        self._pub_dir = pub_dir
+        self._model_zoo = model_zoo
+        self._model_def = model_def
+        self._model_params = model_params
+        self._keep_fulls = max(1, keep_fulls)
+        os.makedirs(pub_dir, exist_ok=True)
+        self._head: Dict[str, np.ndarray] = {}  # key -> packed table
+        self._head_step: Optional[int] = None
+        self._head_signature: Optional[dict] = None
+        self._head_dense: Optional[bytes] = None  # pickled ref-tree
+        self._head_event_time = 0.0
+        self._deltas_since_full = 0
+
+    # ------------------------------------------------------------------
+    # Publishing
+    # ------------------------------------------------------------------
+
+    @property
+    def head_step(self) -> Optional[int]:
+        return self._head_step
+
+    @property
+    def deltas_since_full(self) -> int:
+        return self._deltas_since_full
+
+    def _export_to_tmp(self, trainer) -> str:
+        from elasticdl_tpu.serving.export import export_model
+
+        tmp_dir = tempfile.mkdtemp(prefix="publish.tmp", dir=self._pub_dir)
+        export_model(
+            trainer,
+            tmp_dir,
+            model_zoo=self._model_zoo,
+            model_def=self._model_def,
+            model_params=self._model_params,
+        )
+        return tmp_dir
+
+    def _ingest_tmp(self, tmp_dir: str, event_time: float) -> dict:
+        """Load the freshly exported artifact into the head snapshot."""
+        with open(os.path.join(tmp_dir, "signature.json")) as f:
+            signature = json.load(f)
+        tables = {}
+        for meta in signature["tables"]:
+            # Full in-memory copy: the tmp dir is renamed/deleted next.
+            tables[meta["key"]] = np.array(
+                np.load(os.path.join(tmp_dir, meta["file"]))
+            )
+        with open(os.path.join(tmp_dir, "variables.pkl"), "rb") as f:
+            dense = f.read()
+        self._head = tables
+        self._head_step = int(signature["step"])
+        self._head_signature = signature
+        self._head_dense = dense
+        self._head_event_time = float(event_time)
+        return signature
+
+    def publish_full(self, trainer, event_time: float = 0.0) -> str:
+        """Export a full serving artifact as the new chain base (with the
+        CRC manifest full checkpoints carry) and reset the head."""
+        start = time.monotonic()
+        tmp_dir = self._export_to_tmp(trainer)
+        signature = self._ingest_tmp(tmp_dir, event_time)
+        step = int(signature["step"])
+        # Stamp the event-time frontier into the signature (consumers of
+        # the freshness SLO read it; load_for_serving ignores extras).
+        signature["event_time"] = float(event_time)
+        with open(os.path.join(tmp_dir, "signature.json"), "w") as f:
+            json.dump(signature, f, indent=2)
+        files = ["signature.json", "variables.pkl"] + [
+            meta["file"] for meta in signature["tables"]
+        ]
+        write_integrity_manifest(tmp_dir, files)
+        final_dir = os.path.join(self._pub_dir, _full_name(step))
+        if os.path.exists(final_dir):
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            return final_dir
+        os.rename(tmp_dir, final_dir)
+        self._deltas_since_full = 0
+        save_hist, _restore, saves, _q = _ckpt_metrics()
+        save_hist.observe(time.monotonic() - start, kind="serving_full")
+        saves.inc(kind="serving_full")
+        obs.journal().record(
+            "checkpoint_saved",
+            step=step,
+            kind="serving_full",
+            event_time=float(event_time),
+        )
+        logger.info(
+            "Published full serving artifact at step %d -> %s",
+            step, final_dir,
+        )
+        self._garbage_collect()
+        return final_dir
+
+    def publish_delta(self, trainer, event_time: float = 0.0) -> Optional[str]:
+        """Export only the rows touched since the last publish.  Returns
+        the committed delta dir, or None when no publish happened (step
+        has not advanced past the head)."""
+        if self._head_step is None:
+            raise RuntimeError("publish_full must seed the chain first")
+        start = time.monotonic()
+        tmp_dir = self._export_to_tmp(trainer)
+        with open(os.path.join(tmp_dir, "signature.json")) as f:
+            signature = json.load(f)
+        step = int(signature["step"])
+        base_step = self._head_step
+        if step <= base_step:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            logger.info(
+                "Skipping delta publish: step %d has not advanced past "
+                "head %d", step, base_step,
+            )
+            return None
+
+        delta_tmp = tempfile.mkdtemp(
+            prefix="delta.tmp", dir=self._pub_dir
+        )
+        files: List[str] = [DELTA_MANIFEST, _DENSE_FILE]
+        tables_meta = []
+        total_rows = 0
+        new_tables: Dict[str, np.ndarray] = {}
+        for i, meta in enumerate(signature["tables"]):
+            key = meta["key"]
+            new = np.array(np.load(os.path.join(tmp_dir, meta["file"])))
+            new_tables[key] = new
+            old = self._head.get(key)
+            if old is None or old.shape != new.shape:
+                # Resharded/resized table: every row is "touched".
+                rows = np.arange(new.shape[0], dtype=np.int64)
+            else:
+                rows = np.flatnonzero(
+                    np.any(new != old, axis=tuple(range(1, new.ndim)))
+                ).astype(np.int64)
+            rows_file = f"rows_{i}.npy"
+            vals_file = f"vals_{i}.npy"
+            np.save(os.path.join(delta_tmp, rows_file), rows)
+            np.save(os.path.join(delta_tmp, vals_file), new[rows])
+            files.extend([rows_file, vals_file])
+            total_rows += int(rows.size)
+            tables_meta.append(
+                {
+                    "key": key,
+                    "index": i,
+                    "rows_file": rows_file,
+                    "vals_file": vals_file,
+                    "rows": int(rows.size),
+                    "packed_shape": list(new.shape),
+                    "vocab_size": meta["vocab_size"],
+                    "dim": meta["dim"],
+                }
+            )
+        # Dense params ride along whole: they are dwarfed by the tables
+        # (the asymmetry that makes delta checkpoints pay off at all).
+        shutil.copyfile(
+            os.path.join(tmp_dir, "variables.pkl"),
+            os.path.join(delta_tmp, _DENSE_FILE),
+        )
+        # Captured from the pristine export, NOT re-read from the
+        # published dir below: a torn write must never leak into the
+        # in-memory head, or the next compaction would republish the
+        # corruption under a valid manifest.
+        with open(os.path.join(tmp_dir, "variables.pkl"), "rb") as f:
+            dense_bytes = f.read()
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        manifest = {
+            "format": DELTA_FORMAT,
+            "base_step": base_step,
+            "step": step,
+            "event_time": float(event_time),
+            "tables": tables_meta,
+        }
+        with open(os.path.join(delta_tmp, DELTA_MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=2)
+        write_integrity_manifest(delta_tmp, files)
+        _apply_delta_write_fault(delta_tmp, files)
+        final_dir = os.path.join(
+            self._pub_dir, _delta_name(base_step, step)
+        )
+        os.rename(delta_tmp, final_dir)
+        # Head advances to what was just published — even if a fault tore
+        # the files on disk: the head mirrors the TRAINER, and the next
+        # delta must chain from this step regardless (consumers quarantine
+        # the torn link and wait for compaction to repair the gap).
+        self._head = new_tables
+        self._head_step = step
+        self._head_signature = signature
+        self._head_dense = dense_bytes
+        self._head_event_time = float(event_time)
+        self._deltas_since_full += 1
+        save_hist, _restore, saves, _q = _ckpt_metrics()
+        save_hist.observe(time.monotonic() - start, kind="delta")
+        saves.inc(kind="delta")
+        obs.journal().record(
+            "delta_checkpoint",
+            step=step,
+            base_step=base_step,
+            rows=total_rows,
+            tables=len(tables_meta),
+            event_time=float(event_time),
+        )
+        logger.info(
+            "Published delta %d -> %d (%d changed rows) -> %s",
+            base_step, step, total_rows, final_dir,
+        )
+        return final_dir
+
+    def compact(self) -> Optional[str]:
+        """Fold the head back into a fresh full artifact: bounds chain
+        length and repairs any quarantine gap downstream of the last
+        full (the chain now restarts at the head step)."""
+        if self._head_step is None or self._head_signature is None:
+            return None
+        start = time.monotonic()
+        step = self._head_step
+        final_dir = os.path.join(self._pub_dir, _full_name(step))
+        if os.path.exists(final_dir):
+            return final_dir
+        tmp_dir = tempfile.mkdtemp(prefix="compact.tmp", dir=self._pub_dir)
+        signature = dict(self._head_signature)
+        signature["event_time"] = self._head_event_time
+        files = ["signature.json", "variables.pkl"]
+        os.makedirs(os.path.join(tmp_dir, "tables"), exist_ok=True)
+        for meta in signature["tables"]:
+            np.save(
+                os.path.join(tmp_dir, meta["file"]), self._head[meta["key"]]
+            )
+            files.append(meta["file"])
+        with open(os.path.join(tmp_dir, "variables.pkl"), "wb") as f:
+            f.write(self._head_dense)
+        with open(os.path.join(tmp_dir, "signature.json"), "w") as f:
+            json.dump(signature, f, indent=2)
+        write_integrity_manifest(tmp_dir, files)
+        os.rename(tmp_dir, final_dir)
+        folded = self._deltas_since_full
+        self._deltas_since_full = 0
+        save_hist, _restore, saves, _q = _ckpt_metrics()
+        save_hist.observe(time.monotonic() - start, kind="serving_full")
+        saves.inc(kind="serving_full")
+        obs.journal().record(
+            "delta_compaction",
+            step=step,
+            deltas_folded=folded,
+            event_time=self._head_event_time,
+        )
+        logger.info(
+            "Compacted %d delta(s) into full artifact at step %d",
+            folded, step,
+        )
+        self._garbage_collect()
+        return final_dir
+
+    def _garbage_collect(self):
+        """Drop fulls beyond keep_fulls and deltas wholly covered by the
+        oldest retained full.  Quarantined dirs are never touched."""
+        try:
+            fulls, deltas = scan_pub_dir(self._pub_dir)
+        except OSError:
+            logger.exception("Delta-chain GC scan failed; skipping")
+            return
+        keep = fulls[-self._keep_fulls:]
+        if not keep:
+            return
+        oldest_kept = keep[0]
+        for step in fulls[: -self._keep_fulls]:
+            shutil.rmtree(
+                os.path.join(self._pub_dir, _full_name(step)),
+                ignore_errors=True,
+            )
+        for base_step, step in deltas:
+            if step <= oldest_kept:
+                shutil.rmtree(
+                    os.path.join(self._pub_dir, _delta_name(base_step, step)),
+                    ignore_errors=True,
+                )
+
+
+# ----------------------------------------------------------------------
+# Consumer side: chain resolution and delta loading
+# ----------------------------------------------------------------------
+
+
+def scan_pub_dir(pub_dir: str) -> Tuple[List[int], List[Tuple[int, int]]]:
+    """(sorted full steps, sorted (base_step, step) delta links) committed
+    in `pub_dir` — tmp and quarantined dirs excluded."""
+    fulls: List[int] = []
+    deltas: List[Tuple[int, int]] = []
+    for name in os.listdir(pub_dir):
+        if ".tmp" in name or _QUARANTINE_SUFFIX in name:
+            continue
+        if name.startswith("full_"):
+            try:
+                fulls.append(int(name[len("full_"):]))
+            except ValueError:
+                continue
+        elif name.startswith("delta_"):
+            parts = name[len("delta_"):].split("_")
+            try:
+                base_step, step = int(parts[0]), int(parts[1])
+            except (IndexError, ValueError):
+                continue
+            deltas.append((base_step, step))
+    return sorted(fulls), sorted(deltas)
+
+
+def resolve_chain(
+    pub_dir: str, check_crc: bool = True
+) -> Tuple[Optional[str], List[str]]:
+    """(newest good full dir, deltas linked from it in apply order).
+
+    Every candidate link is integrity-verified; proven corruption is
+    quarantined (journaled) and the walk degrades: a corrupt full falls
+    back to the previous full, a corrupt delta ENDS the chain there —
+    the consumer serves stale-but-correct until compaction republishes.
+    Transient I/O (OSError from verification) skips the link for this
+    resolve without quarantining, same as full-checkpoint restore."""
+    fulls, deltas = scan_pub_dir(pub_dir)
+    base_dir = None
+    base_step = None
+    for step in reversed(fulls):
+        full_dir = os.path.join(pub_dir, _full_name(step))
+        try:
+            reason = verify_integrity(full_dir, check_crc=check_crc)
+        except OSError:
+            logger.exception(
+                "Could not verify full artifact %s (transient I/O?); "
+                "skipping it this resolve", full_dir,
+            )
+            continue
+        if reason is not None:
+            quarantine_artifact(full_dir, reason)
+            continue
+        base_dir, base_step = full_dir, step
+        break
+    if base_dir is None:
+        return None, []
+    chain: List[str] = []
+    links = {bs: st for bs, st in deltas}
+    cursor = base_step
+    while cursor in links:
+        step = links[cursor]
+        delta_dir = os.path.join(pub_dir, _delta_name(cursor, step))
+        try:
+            reason = verify_integrity(delta_dir, check_crc=check_crc)
+        except OSError:
+            logger.exception(
+                "Could not verify delta %s (transient I/O?); chain stops "
+                "here this resolve", delta_dir,
+            )
+            break
+        if reason is not None:
+            quarantine_artifact(delta_dir, reason)
+            break
+        chain.append(delta_dir)
+        cursor = step
+    return base_dir, chain
+
+
+def load_delta(delta_dir: str) -> dict:
+    """Load one committed delta link: its manifest, per-table
+    (rows, vals) arrays keyed by table key, and the pickled dense
+    variables tree (embedding leaves still {"__table__": ...} refs)."""
+    with open(os.path.join(delta_dir, DELTA_MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != DELTA_FORMAT:
+        raise ValueError(
+            f"{delta_dir}: unknown delta format {manifest.get('format')!r}"
+        )
+    tables = {}
+    for meta in manifest["tables"]:
+        rows = np.load(os.path.join(delta_dir, meta["rows_file"]))
+        vals = np.load(os.path.join(delta_dir, meta["vals_file"]))
+        if rows.shape[0] != vals.shape[0]:
+            raise ValueError(
+                f"{delta_dir}: rows/vals length mismatch for "
+                f"{meta['key']} ({rows.shape[0]} != {vals.shape[0]})"
+            )
+        tables[meta["key"]] = (rows, vals, meta)
+    with open(os.path.join(delta_dir, _DENSE_FILE), "rb") as f:
+        dense = pickle.load(f)
+    return {"manifest": manifest, "tables": tables, "dense": dense}

@@ -23,6 +23,17 @@ Injection sites wired into the framework:
     worker.task    every task a worker starts processing
     worker.step    every train batch in the simple worker
                    (kind: crash[=exit_code] — SIGKILL-equivalent)
+    stream.source  every SyntheticClickStream.advance (kind:
+                   latency[=seconds] — a wedged upstream pipe stalls
+                   production for that much VIRTUAL time; @t specs are
+                   applied by the driver via due() + stream.stall())
+    ckpt.delta     every delta-checkpoint publish (kind:
+                   truncate[=keep_bytes] — tears the largest delta file
+                   after its checksum is manifested)
+    serving.delta_apply
+                   every serving-side delta apply (kind: error[=msg] —
+                   the apply fails and rolls back to the previous
+                   generation)
 
 Spec grammar (comma/semicolon separated, via `ELASTICDL_FAULTS` or
 `install()`):

@@ -1,0 +1,206 @@
+"""Unbounded stream sources for the continuous train->serve loop.
+
+A *stream* is an append-only record log: offsets are dense integers,
+each record carries an **event time** (when the click/impression
+happened), and production never ends.  The master's streaming task
+dispatcher (master/stream.py) cuts the log into the same shard-task
+ranges the bounded dispatcher uses — the stream is the dataset, the
+offsets are the shard.
+
+`SyntheticClickStream` is the deterministic test double: production
+follows a piecewise-constant **rate schedule** on a virtual timeline the
+driver owns (`advance(dt)` — no wall clock anywhere, so a chaos run
+replays exactly), and `event_time(offset)` inverts the schedule.  A
+mid-run rate spike is one extra schedule phase; a stalled source
+(`stream.source` fault site, kind `latency`) shifts *production* without
+shifting event times — exactly how a wedged upstream pipe manifests as
+event-time lag.
+
+Reading a task's range rides the PR-14 Prefetcher (bounded lookahead,
+synchronous close-drain), so worker churn never leaks a stale window
+across a rendezvous generation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.data.pipeline import Prefetcher
+
+logger = get_logger("data.stream")
+
+
+class SyntheticClickStream:
+    """Deterministic unbounded click stream on a driver-owned timeline.
+
+    `schedule` is a sequence of ``(duration_s, records_per_s)`` phases;
+    the LAST phase's rate continues forever (a stream has no end).  All
+    timing is virtual: the driver calls `advance(dt)` to move the
+    production clock, so availability, event times, and stalls replay
+    bit-exactly regardless of host speed.
+    """
+
+    def __init__(
+        self,
+        schedule: Sequence[Tuple[float, float]],
+        name: str = "stream",
+    ):
+        if not schedule:
+            raise ValueError("stream schedule needs at least one phase")
+        for duration, rate in schedule:
+            if duration < 0 or rate < 0:
+                raise ValueError(f"bad schedule phase ({duration}, {rate})")
+        if schedule[-1][1] <= 0:
+            raise ValueError("final schedule phase must have rate > 0")
+        self.name = name
+        self._schedule: List[Tuple[float, float]] = [
+            (float(d), float(r)) for d, r in schedule
+        ]
+        self._elapsed = 0.0
+        self._stall_s = 0.0
+        self._closed = False
+
+    # -- the driver-owned clock -----------------------------------------
+
+    def advance(self, dt_s: float) -> None:
+        """Move the virtual production clock forward."""
+        if dt_s < 0:
+            raise ValueError("time only moves forward")
+        self._elapsed += dt_s
+        # Call-count-triggered stall (`stream.source:latency=SECONDS@N`):
+        # the Nth advance wedges the source for SECONDS of virtual time.
+        spec = faults.fire("stream.source")
+        if spec is not None and spec.kind == "latency":
+            self.stall(float(spec.arg or 1.0))
+
+    def stall(self, seconds: float) -> None:
+        """A wedged upstream pipe: production stops for `seconds` of
+        virtual time.  Event times are unaffected — the records were
+        already minted upstream, they just arrive late (that is what
+        event-time lag measures).  Drivers applying schedule-based
+        `stream.source` specs (`faults.due`) call this directly."""
+        self._stall_s += float(seconds)
+        logger.warning(
+            "FAULT INJECTION: stream %s stalled %.3fs (total stall %.3fs)",
+            self.name, seconds, self._stall_s,
+        )
+
+    @property
+    def elapsed_s(self) -> float:
+        return self._elapsed
+
+    def close(self) -> None:
+        """Bounded-test escape hatch: no records beyond the current
+        availability; the dispatcher may then drain and finish."""
+        self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- production / event-time math -----------------------------------
+
+    def records_until(self, elapsed_s: float) -> int:
+        """Records produced by `elapsed_s` on an unstalled timeline
+        (the integral of the rate schedule)."""
+        remaining = max(0.0, float(elapsed_s))
+        records = 0.0
+        for i, (duration, rate) in enumerate(self._schedule):
+            last = i == len(self._schedule) - 1
+            span = remaining if last else min(remaining, duration)
+            records += span * rate
+            remaining -= span
+            if remaining <= 0:
+                break
+        return int(records)
+
+    def available(self) -> int:
+        """Records that have ARRIVED by now: production shifted by every
+        stall so far.  Monotone in elapsed time."""
+        return self.records_until(self._elapsed - self._stall_s)
+
+    def event_time(self, offset: int) -> float:
+        """Event time (virtual seconds since stream start) of record
+        `offset` — the schedule's inverse, stall-independent."""
+        offset = max(0, int(offset))
+        produced = 0.0
+        start = 0.0
+        for i, (duration, rate) in enumerate(self._schedule):
+            last = i == len(self._schedule) - 1
+            phase_records = float("inf") if last else duration * rate
+            if offset < produced + phase_records:
+                if rate <= 0:
+                    return start + duration
+                return start + (offset - produced) / rate
+            produced += phase_records
+            start += duration
+        return start
+
+    # -- serialisation (master resume) ----------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "schedule": [list(p) for p in self._schedule],
+            "elapsed": self._elapsed,
+            "stall_s": self._stall_s,
+            "closed": self._closed,
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "SyntheticClickStream":
+        stream = cls(
+            [tuple(p) for p in obj["schedule"]], name=obj.get("name", "stream")
+        )
+        stream._elapsed = float(obj.get("elapsed", 0.0))
+        stream._stall_s = float(obj.get("stall_s", 0.0))
+        stream._closed = bool(obj.get("closed", False))
+        return stream
+
+
+def synthetic_click_batch(
+    lo: int,
+    hi: int,
+    vocab_size: int,
+    fields: Sequence[str] = ("user", "item"),
+) -> dict:
+    """Deterministic feature batch for offsets [lo, hi): each record's
+    ids are a pure function of its offset, so any worker that replays a
+    requeued range trains on the identical batch (the at-least-once
+    replay contract extends to the data)."""
+    offsets = np.arange(int(lo), int(hi), dtype=np.int64)
+    return {
+        name: ((offsets * (31 + 17 * i) + 7 * i) % vocab_size).astype(
+            np.int64
+        )
+        for i, name in enumerate(fields)
+    }
+
+
+def iter_stream_batches(
+    make_batch: Callable[[int, int], object],
+    lo: int,
+    hi: int,
+    batch_size: int,
+    prefetch: int = 2,
+) -> Iterator[object]:
+    """One task range [lo, hi) as a prefetched batch iterator: the
+    stream-worker analogue of the bounded pipeline's readahead.  The
+    Prefetcher's synchronous close() drain runs on generator close, so a
+    churned worker abandoning the range leaves no producer thread and no
+    buffered window behind."""
+
+    def windows():
+        for start in range(int(lo), int(hi), int(batch_size)):
+            yield make_batch(start, min(start + batch_size, int(hi)))
+
+    prefetcher = Prefetcher(windows(), max_inflight=prefetch)
+    try:
+        for batch in prefetcher:
+            yield batch
+    finally:
+        prefetcher.close()

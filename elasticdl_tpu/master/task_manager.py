@@ -298,7 +298,16 @@ class TaskManager:
                     self._recover_timed_out_locked()
                 )
                 journal_events.extend(expired_events)
+                # Streaming hook (master/stream.py): top up the queue from
+                # an unbounded source under the same lock hold, so a
+                # stream dispatcher rides this exact protocol.
+                self._maybe_refill_locked(journal_events)
                 if not self._todo and not self._doing:
+                    if self._stream_open_locked():
+                        # Unbounded source: the queue is momentarily dry
+                        # but the stream can still produce — never an
+                        # epoch barrier, never job-complete.
+                        return pb.Task(task_id=-1, type=pb.WAIT)
                     # Current epoch fully finished: advance or end.
                     if self._epoch + 1 < self._num_epochs and self._training_shards:
                         finished_epoch = self._epoch
@@ -448,6 +457,10 @@ class TaskManager:
                     self._metrics.record_rate.add(records)
                 if task.type == pb.TRAINING:
                     self._finished_record_count += task.end - task.start
+                    # Streaming hook: watermark advance on completed
+                    # stream ranges (events appended, emitted below
+                    # outside the lock like every other journal write).
+                    self._note_task_complete_locked(task, journal_events)
                 if task.type == pb.EVALUATION:
                     eval_done_cbs = list(self._eval_task_done_callbacks)
                 for key, value in (exec_counters or {}).items():
@@ -513,7 +526,12 @@ class TaskManager:
                 # (eval/predict replays cost no training records).
                 if task.type == pb.TRAINING:
                     self._recovered_record_count += task.end - task.start
-            if not self._todo and not self._doing and not self._done_callbacks_fired:
+            if (
+                not self._todo
+                and not self._doing
+                and not self._done_callbacks_fired
+                and not self._stream_open_locked()
+            ):
                 if self._epoch + 1 >= self._num_epochs or not self._training_shards:
                     self._done_callbacks_fired = True
                     self._finalizing = True
@@ -559,6 +577,31 @@ class TaskManager:
         finally:
             with self._lock:
                 self._finalizing = False
+
+    # ------------------------------------------------------------------
+    # Streaming hooks (overridden by master/stream.StreamingTaskManager)
+    # ------------------------------------------------------------------
+
+    def _maybe_refill_locked(self, journal_events: List[dict]) -> None:
+        """Called under the lock at the top of every get(): an unbounded
+        source tops the queue up here (bounded lookahead).  Base: no-op."""
+
+    def _stream_open_locked(self) -> bool:
+        """True while an unbounded source can still produce records —
+        gates the epoch-advance / job-complete branches.  Base: False."""
+        return False
+
+    def _note_task_complete_locked(
+        self, task: _Task, journal_events: List[dict]
+    ) -> None:
+        """Called under the lock for every successfully completed
+        TRAINING task: the streaming dispatcher advances its watermark
+        here.  Base: no-op."""
+
+    def _checkpoint_extra_locked(self) -> Dict[str, object]:
+        """Extra JSON merged into to_checkpoint() under the lock (the
+        streaming dispatcher persists its stream cursor).  Base: {}."""
+        return {}
 
     def recover_tasks(self, worker_id: int) -> int:
         """Requeue all tasks in-flight on a dead/removed worker."""
@@ -711,6 +754,7 @@ class TaskManager:
                 not self._todo
                 and not self._doing
                 and no_more_epochs
+                and not self._stream_open_locked()
                 and (finalization_settled or not self._tasks_done_callbacks)
             )
 
@@ -753,18 +797,18 @@ class TaskManager:
         with self._lock:
             todo = [t.to_json() for t in self._todo]
             todo.extend(t.to_json() for (_w, t, _s, _tr) in self._doing.values())
-            return json.dumps(
-                {
-                    "epoch": self._epoch,
-                    "num_epochs": self._num_epochs,
-                    "records_per_task": self._records_per_task,
-                    "finished_record_count": self._finished_record_count,
-                    "training_shards": self._training_shards,
-                    "evaluation_shards": self._evaluation_shards,
-                    "prediction_shards": self._prediction_shards,
-                    "todo": todo,
-                }
-            )
+            state = {
+                "epoch": self._epoch,
+                "num_epochs": self._num_epochs,
+                "records_per_task": self._records_per_task,
+                "finished_record_count": self._finished_record_count,
+                "training_shards": self._training_shards,
+                "evaluation_shards": self._evaluation_shards,
+                "prediction_shards": self._prediction_shards,
+                "todo": todo,
+            }
+            state.update(self._checkpoint_extra_locked())
+            return json.dumps(state)
 
     @classmethod
     def from_checkpoint(

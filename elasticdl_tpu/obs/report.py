@@ -213,7 +213,64 @@ def summarize(events: List[dict]) -> dict:
                 "records_redone", "rescales",
             )
         }
+    freshness = _freshness_summary(events)
+    if freshness:
+        summary["freshness"] = freshness
     return summary
+
+
+def _freshness_summary(events: List[dict]) -> Optional[dict]:
+    """Fold the continuous-loop events (stream_watermark,
+    delta_checkpoint, delta_compaction, freshness_slo) into one section.
+    Returns None when the journal predates the continuous loop, so old
+    journals render no section at all."""
+    watermarks = [e for e in events if e.get("event") == "stream_watermark"]
+    deltas = [e for e in events if e.get("event") == "delta_checkpoint"]
+    compactions = [e for e in events if e.get("event") == "delta_compaction"]
+    slo_events = [e for e in events if e.get("event") == "freshness_slo"]
+    quarantines = [
+        e for e in events if e.get("event") == "checkpoint_quarantined"
+    ]
+    if not (watermarks or deltas or compactions or slo_events):
+        return None
+    section: dict = {
+        "watermark_updates": len(watermarks),
+        "deltas_published": len(deltas),
+        "delta_rows": sum(
+            int(e.get("rows") or 0)
+            for e in deltas
+            if isinstance(e.get("rows"), (int, float))
+        ),
+        "compactions": len(compactions),
+        "quarantines": len(quarantines),
+        "breaches": sum(1 for e in slo_events if e.get("state") == "breach"),
+    }
+    if watermarks:
+        last = watermarks[-1]
+        section["last_watermark"] = {
+            "offset": last.get("offset"),
+            "event_time": last.get("event_time"),
+        }
+    if slo_events:
+        last = slo_events[-1]
+        section["slo_s"] = last.get("slo_s")
+        section["final_state"] = last.get("state")
+        section["transitions"] = [
+            {
+                key: e.get(key)
+                for key in ("state", "lag_s", "stage", "generation", "step")
+            }
+            for e in slo_events
+        ]
+        breach_lags = [
+            float(e["lag_s"])
+            for e in slo_events
+            if e.get("state") == "breach"
+            and isinstance(e.get("lag_s"), (int, float))
+        ]
+        if breach_lags:
+            section["max_breach_lag_s"] = round(max(breach_lags), 6)
+    return section
 
 
 #: Rows in the "slowest task chains" table.
@@ -500,6 +557,48 @@ def render_report(summary: dict, max_segments: int = 80) -> str:
             f"{ledger.get('records_redone')}, rescales "
             f"{ledger.get('rescales')}"
         )
+    freshness = summary.get("freshness")
+    if freshness:
+        lines.append("")
+        lines.append("continuous train->serve loop:")
+        last_wm = freshness.get("last_watermark")
+        if last_wm:
+            lines.append(
+                f"  watermark: offset {last_wm.get('offset')} "
+                f"(event time {last_wm.get('event_time')}s, "
+                f"{freshness['watermark_updates']} advance(s))"
+            )
+        lines.append(
+            f"  deltas: {freshness['deltas_published']} published "
+            f"({freshness['delta_rows']} rows), "
+            f"{freshness['compactions']} compaction(s), "
+            f"{freshness['quarantines']} quarantined artifact(s)"
+        )
+        if freshness.get("slo_s") is not None:
+            state = freshness.get("final_state")
+            lines.append(
+                f"  freshness SLO {freshness['slo_s']}s: "
+                f"{freshness['breaches']} breach(es), "
+                f"final state {state}"
+                + (
+                    f", worst lag "
+                    f"{_fmt_duration(freshness['max_breach_lag_s'])}"
+                    if freshness.get("max_breach_lag_s") is not None
+                    else ""
+                )
+            )
+            for t in freshness.get("transitions", ()):
+                lines.append(
+                    f"    {t.get('state'):>6}  lag {t.get('lag_s')}s"
+                    + (
+                        f"  (stage: {t.get('stage')}, gen "
+                        f"{t.get('generation')}, step {t.get('step')})"
+                        if t.get("state") == "breach"
+                        else ""
+                    )
+                )
+        elif freshness["breaches"] == 0:
+            lines.append("  freshness SLO: not configured")
     lines.append("")
     lines.append("timeline:")
     segments = summary["segments"]

@@ -67,8 +67,8 @@ _SERVING_HEADER_GAUGES = (
 )
 
 _SERVING_COLUMNS = (
-    "REPLICA", "AGE(s)", "GEN", "STEP", "QPS", "P50(ms)", "P99(ms)",
-    "QUEUE", "INFLT", "AVAIL%", "SERVED", "SHED", "ERR",
+    "REPLICA", "AGE(s)", "GEN", "STEP", "FRESH(s)", "QPS", "P50(ms)",
+    "P99(ms)", "QUEUE", "INFLT", "AVAIL%", "SERVED", "SHED", "ERR",
 )
 
 
@@ -215,6 +215,32 @@ def worker_rows(
     return rows
 
 
+def freshness_note(events: List[dict]) -> str:
+    """The freshness-SLO state line for the serving frame — "" against
+    journals from masters predating the freshness plane (no
+    `freshness_slo` events; degrade, never raise)."""
+    last = None
+    for event in events:
+        if event.get("event") == "freshness_slo":
+            last = event
+    if not isinstance(last, dict) or last.get("state") not in (
+        "breach", "clear"
+    ):
+        return ""
+    try:
+        lag = float(last.get("lag_s", 0.0))
+        slo = float(last.get("slo_s", 0.0))
+    except (TypeError, ValueError):
+        return ""
+    if last["state"] == "breach":
+        note = f"freshness: BREACH lag={lag:.1f}s > slo={slo:.1f}s"
+        stage = last.get("stage")
+        if stage:
+            note += f" (stage: {stage})"
+        return note
+    return f"freshness: ok (last clear at lag={lag:.1f}s, slo={slo:.1f}s)"
+
+
 def serving_rows(
     events: List[dict], now: Optional[float] = None
 ) -> List[dict]:
@@ -224,8 +250,17 @@ def serving_rows(
     replacement appears under a fresh id)."""
     now = time.time() if now is None else now
     latest: Dict[int, dict] = {}
+    watermark_et = None
     for event in events:
-        if event.get("event") != "serving_telemetry":
+        kind = event.get("event")
+        if kind == "stream_watermark":
+            # Trained event-time frontier: the reference point the
+            # per-replica freshness column measures against.
+            et = event.get("event_time")
+            if isinstance(et, (int, float)):
+                watermark_et = float(et)
+            continue
+        if kind != "serving_telemetry":
             continue
         rid = event.get("replica_id")
         if rid is None:
@@ -235,12 +270,22 @@ def serving_rows(
     for rid in sorted(latest):
         event = latest[rid]
         avail = event.get("availability_ratio")
+        # Replica freshness: how far its servable model's event-time
+        # frontier trails the trained watermark.  "-" against journals
+        # from masters predating the continuous loop (no watermark
+        # events, or telemetry without model_event_time) — degrade,
+        # never raise.
+        model_et = event.get("model_event_time")
+        fresh_s = None
+        if watermark_et is not None and isinstance(model_et, (int, float)):
+            fresh_s = max(0.0, watermark_et - float(model_et))
         rows.append(
             {
                 "replica": rid,
                 "age_s": round(max(0.0, now - float(event.get("ts", now))), 1),
                 "generation": event.get("generation", 0),
                 "step": event.get("step", 0),
+                "fresh_s": fresh_s,
                 "qps": float(event.get("qps", 0.0) or 0.0),
                 "p50_ms": event.get("p50_ms"),
                 "p99_ms": event.get("p99_ms"),
@@ -277,6 +322,7 @@ def render_serving(
                 f"{row['age_s']:.1f}",
                 str(row["generation"]),
                 str(row["step"]),
+                "-" if row.get("fresh_s") is None else f"{row['fresh_s']:.1f}",
                 f"{row['qps']:.1f}",
                 _fixed_ms(row["p50_ms"]),
                 _fixed_ms(row["p99_ms"]),
@@ -410,6 +456,9 @@ def snapshot_frame(addr: str, tail: int = 256, serving: bool = False) -> str:
     except (urllib.error.URLError, OSError, ValueError) as exc:
         notes.append(f"(journal endpoint unavailable: {exc})")
     if serving:
+        fresh = freshness_note(events)
+        if fresh:
+            notes.append(fresh)
         return render_serving(
             serving_rows(events),
             parse_metrics(metrics_text),

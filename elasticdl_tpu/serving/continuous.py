@@ -1,0 +1,133 @@
+"""Continuous serving: keep a replica tracking the published delta chain.
+
+The training side publishes into a *pub dir* (checkpoint/delta.py): full
+serving artifacts plus delta links chaining forward from them.  The
+`DeltaWatcher` is the serving-side consumer: each poll resolves the
+newest good chain (corrupt links are quarantined by `resolve_chain`
+itself) and walks the replica forward —
+
+- behind the newest full  -> one hot-swap `reload` to the full,
+- then every delta link    -> `apply_delta` (no reload, no recompile),
+- a failed/corrupt apply   -> STOP.  The replica keeps serving its
+  current generation (runtime.apply_delta already rolled back and
+  journaled `model_swap` outcome=rolled_back); the next poll retries,
+  and a compaction publish repairs the gap.
+
+That is the degradation ladder's middle rung: *stale-serving* — behind
+the stream but answering every request, visible in the freshness lag
+metric, never down.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+from elasticdl_tpu.checkpoint.delta import resolve_chain
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("serving.continuous")
+
+
+def _parse_steps(path: str) -> Tuple[Optional[int], Optional[int]]:
+    """(base_step, step) for a delta dir, (None, step) for a full dir."""
+    name = os.path.basename(path.rstrip("/"))
+    if name.startswith("full_"):
+        return None, int(name[len("full_"):])
+    if name.startswith("delta_"):
+        base, step = name[len("delta_"):].split("_")[:2]
+        return int(base), int(step)
+    raise ValueError(f"not a chain artifact: {path}")
+
+
+class DeltaWatcher:
+    """Polls a pub dir and advances one ServingReplica along the chain.
+
+    `poll_once()` is the whole protocol (deterministic, driver-callable
+    from tests); `start(interval_s)` runs it on a daemon thread for real
+    replicas.  `freshness` (an obs.freshness.FreshnessTracker) is
+    optional: when present, every applied generation feeds its
+    serving-side event-time frontier."""
+
+    def __init__(self, replica, pub_dir: str, freshness=None):
+        self._replica = replica
+        self._pub_dir = pub_dir
+        self._freshness = freshness
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> dict:
+        """One resolve-and-advance pass.  Never raises: a failed link
+        leaves the replica stale-serving and is retried next poll."""
+        summary = {
+            "reloaded_full": False,
+            "applied_deltas": 0,
+            "failed": None,
+            "step": self._replica.generation.step,
+        }
+        try:
+            base_dir, chain = resolve_chain(self._pub_dir)
+        except OSError:
+            logger.exception("Chain resolve failed (transient I/O?)")
+            return summary
+        if base_dir is None:
+            return summary
+        _none, base_step = _parse_steps(base_dir)
+        current = self._replica.generation.step
+        if current < base_step:
+            # Behind the newest full (cold start, or a quarantine gap a
+            # compaction just repaired): one full hot-swap catches up.
+            try:
+                self._replica.reload(base_dir)
+            except Exception:
+                summary["failed"] = base_dir
+                summary["step"] = self._replica.generation.step
+                return summary
+            current = self._replica.generation.step
+            summary["reloaded_full"] = True
+            self._note_freshness()
+        for delta_dir in chain:
+            delta_base, delta_step = _parse_steps(delta_dir)
+            if delta_step <= current:
+                continue  # already ahead of this link
+            if delta_base != current:
+                break  # gap relative to our position; wait for compaction
+            try:
+                self._replica.apply_delta(delta_dir)
+            except Exception:
+                # Rolled back (journaled by the runtime).  Stale-serving
+                # from here; the next poll retries the link.
+                summary["failed"] = delta_dir
+                break
+            current = delta_step
+            summary["applied_deltas"] += 1
+            self._note_freshness()
+        summary["step"] = self._replica.generation.step
+        return summary
+
+    def _note_freshness(self):
+        if self._freshness is not None:
+            gen = self._replica.generation
+            self._freshness.note_served(gen.gen_id, gen.step, gen.event_time)
+
+    # -- background operation -------------------------------------------
+
+    def start(self, interval_s: float = 2.0) -> "DeltaWatcher":
+        def _loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.poll_once()
+                except Exception:
+                    logger.exception("Delta watcher poll failed; will retry")
+
+        self._thread = threading.Thread(
+            target=_loop, name="delta-watcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -198,11 +198,22 @@ class ServingModel:
     outputs are bit-identical to the trainer's eval for the same inputs.
     """
 
-    def __init__(self, model, variables: Dict, signature: dict, base_dir: str):
+    def __init__(
+        self,
+        model,
+        variables: Dict,
+        signature: dict,
+        base_dir: str,
+        tables: Optional[Dict[str, np.ndarray]] = None,
+    ):
         self._model = model
         self._variables = variables
         self.signature = signature
         self._base_dir = base_dir
+        # key -> resolved packed table (host view); what delta apply
+        # patches row-wise (serving/runtime.py).  Empty for artifacts
+        # loaded by callers that never delta-apply.
+        self.tables: Dict[str, np.ndarray] = tables or {}
 
     def predict(self, features):
         from elasticdl_tpu.worker.trainer import _model_apply
@@ -219,6 +230,10 @@ class ServingModel:
     @property
     def variables(self) -> Dict:
         return self._variables
+
+    @property
+    def base_dir(self) -> str:
+        return self._base_dir
 
     def logical_tables(self) -> Dict[str, np.ndarray]:
         """Unpacked [vocab, dim] embedding tables (external-consumer view:
@@ -250,12 +265,19 @@ def load_for_serving(
     with open(os.path.join(out_dir, _VARIABLES), "rb") as f:
         variables = pickle.load(f)
 
+    key_by_file = {m["file"]: m["key"] for m in signature.get("tables", [])}
+    tables: Dict[str, np.ndarray] = {}
+
     def resolve(leaf):
         if isinstance(leaf, dict) and _TABLE_REF in leaf:
-            return np.load(
+            array = np.load(
                 os.path.join(out_dir, leaf[_TABLE_REF]),
                 mmap_mode="r" if mmap else None,
             )
+            key = key_by_file.get(leaf[_TABLE_REF])
+            if key is not None:
+                tables[key] = array
+            return array
         return leaf
 
     variables = _map_tree_with_refs(variables, resolve)
@@ -271,7 +293,7 @@ def load_for_serving(
         custom_data_reader="",
     )
     model = load_model_spec(spec_args).build_model()
-    return ServingModel(model, variables, signature, out_dir)
+    return ServingModel(model, variables, signature, out_dir, tables=tables)
 
 
 def _map_tree_with_refs(tree, fn):

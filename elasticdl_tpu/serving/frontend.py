@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import threading
 from typing import Dict, Optional
 
@@ -131,10 +132,19 @@ class PredictServicer:
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT, f"bad reload payload: {exc}"
             )
+        # A delta link (checkpoint/delta.py artifact) applies in place —
+        # no full reload; a failed apply rolled back, the old generation
+        # still answers, and the INTERNAL status tells the caller so.
+        is_delta = os.path.exists(os.path.join(model_dir, "delta.json"))
         try:
-            self._replica.reload(model_dir)
+            if is_delta:
+                self._replica.apply_delta(model_dir)
+            else:
+                self._replica.reload(model_dir)
         except Exception as exc:
-            logger.exception("hot-swap reload failed")
+            logger.exception(
+                "%s failed", "delta apply" if is_delta else "hot-swap reload"
+            )
             context.abort(grpc.StatusCode.INTERNAL, f"reload failed: {exc}")
         return self.stats(b"", context)
 

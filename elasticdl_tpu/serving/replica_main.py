@@ -106,6 +106,14 @@ def parse_replica_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--max_wait_us", type=int, default=2000)
     parser.add_argument("--queue_limit", type=int, default=256)
     parser.add_argument("--telemetry_interval_s", type=float, default=1.0)
+    parser.add_argument("--pub_dir", default="",
+                        help="delta-chain publish dir (checkpoint/delta.py); "
+                             "when set, a DeltaWatcher keeps this replica "
+                             "tracking the newest servable generation")
+    parser.add_argument("--pub_poll_interval_s", type=float, default=2.0)
+    parser.add_argument("--freshness_slo_s", type=float, default=0.0,
+                        help="event-time -> servable-model lag SLO; 0 "
+                             "disables breach evaluation")
     parser.add_argument("--warmup_features", default="",
                         help="npz file of one example request; every "
                              "padded bucket is pre-traced from it")
@@ -127,6 +135,7 @@ def _telemetry_loop(stop: threading.Event, interval_s: float, replica,
             replica_id=replica_id,
             generation=stats["generation"],
             step=stats["step"],
+            model_event_time=stats.get("model_event_time", 0.0),
             inflight=stats["inflight"],
             queue_depth=batcher.queue_depth(),
             qps=snap["qps"],
@@ -210,8 +219,28 @@ def main(argv=None) -> int:
     )
     telemetry.start()
 
+    watcher = None
+    if args.pub_dir:
+        from elasticdl_tpu.obs.freshness import FreshnessTracker
+        from elasticdl_tpu.serving.continuous import DeltaWatcher
+
+        freshness = (
+            FreshnessTracker(args.freshness_slo_s)
+            if args.freshness_slo_s > 0
+            else None
+        )
+        watcher = DeltaWatcher(
+            replica, args.pub_dir, freshness=freshness
+        ).start(args.pub_poll_interval_s)
+        logger.info(
+            "Tracking delta chain in %s every %.1fs", args.pub_dir,
+            args.pub_poll_interval_s,
+        )
+
     while not stop.wait(0.5):
         pass
+    if watcher is not None:
+        watcher.stop()
     frontend.stop()
     batcher.stop()
     exporter.stop()

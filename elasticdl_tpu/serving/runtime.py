@@ -100,12 +100,18 @@ class Generation:
         served: ServingModel,
         variables,
         serve_fn,
+        shardings=None,
+        event_time: float = 0.0,
     ):
         self.gen_id = gen_id
         self.model_dir = model_dir
         self.served = served
         self.variables = variables
         self.serve_fn = serve_fn
+        # Placement tree + event-time frontier: what delta apply needs to
+        # re-place patched variables and what the freshness SLO reads.
+        self.shardings = shardings
+        self.event_time = float(event_time)
         self._lock = make_lock("Generation._lock")
         self._inflight = 0  # guarded-by: _lock
         self._idle = threading.Condition(self._lock)
@@ -216,7 +222,15 @@ class ServingReplica:
         with self._lock:
             gen_id = self._next_gen_id
             self._next_gen_id += 1
-        return Generation(gen_id, model_dir, served, variables, serve_fn)
+        return Generation(
+            gen_id,
+            model_dir,
+            served,
+            variables,
+            serve_fn,
+            shardings=shardings,
+            event_time=float(served.signature.get("event_time", 0.0)),
+        )
 
     # -- the dispatch path ----------------------------------------------
 
@@ -248,8 +262,35 @@ class ServingReplica:
         """Atomic generation swap: the new generation is fully built
         (loaded, placed, compiled) BEFORE the pointer moves, then the
         old generation drains its in-flight dispatches — zero in-flight
-        requests are dropped by a swap."""
-        new_gen = self._load_generation(model_dir)
+        requests are dropped by a swap.
+
+        A failed build — corrupt artifact, bad pickle, compile error —
+        never touches the generation pointer: the old generation keeps
+        serving (stale, ledger-visible, never down) and the rollback is
+        journaled as a `model_swap` with ``outcome=rolled_back``."""
+        try:
+            new_gen = self._load_generation(model_dir)
+        except Exception as exc:
+            old_gen = self.generation
+            obs.journal().record(
+                "model_swap",
+                kind="full",
+                outcome="rolled_back",
+                generation=old_gen.gen_id,
+                step=old_gen.step,
+                old_generation=old_gen.gen_id,
+                old_step=old_gen.step,
+                model_dir=model_dir,
+                reason=repr(exc),
+            )
+            logger.exception(
+                "Reload from %s failed; generation %d (step %d) keeps "
+                "serving", model_dir, old_gen.gen_id, old_gen.step,
+            )
+            raise
+        return self._swap(new_gen, model_dir, kind="full")
+
+    def _swap(self, new_gen: Generation, model_dir: str, kind: str) -> Generation:
         with self._lock:
             old_gen = self._generation
             self._generation = new_gen
@@ -262,6 +303,8 @@ class ServingReplica:
             )
         obs.journal().record(
             "model_swap",
+            kind=kind,
+            outcome="applied",
             generation=new_gen.gen_id,
             step=new_gen.step,
             old_generation=old_gen.gen_id,
@@ -269,14 +312,133 @@ class ServingReplica:
             model_dir=model_dir,
             drained_inflight=inflight_at_swap,
             undrained=leftover,
+            event_time=new_gen.event_time,
         )
         logger.info(
-            "Hot-swapped generation %d (step %d) -> %d (step %d); drained "
-            "%d in-flight dispatch(es)",
-            old_gen.gen_id, old_gen.step, new_gen.gen_id, new_gen.step,
-            inflight_at_swap,
+            "Hot-swapped (%s) generation %d (step %d) -> %d (step %d); "
+            "drained %d in-flight dispatch(es)",
+            kind, old_gen.gen_id, old_gen.step, new_gen.gen_id,
+            new_gen.step, inflight_at_swap,
         )
         return new_gen
+
+    def apply_delta(self, delta_dir: str) -> Generation:
+        """Apply a delta checkpoint WITHOUT a full reload: patch the
+        current generation's host tables row-wise, re-place them with the
+        generation's own shardings, and reuse its compiled step (shapes
+        and placement are unchanged by construction — no recompile, no
+        retrace).  The pointer swap + drain are the same protocol as
+        `reload`.
+
+        Any failure — injected `serving.delta_apply` fault, integrity
+        mismatch (the delta is quarantined), a chain gap (base_step !=
+        the serving step) — rolls back atomically: the pointer never
+        moved, the old generation keeps answering, and the journal
+        carries a `model_swap` with ``outcome=rolled_back``."""
+        from elasticdl_tpu.common import faults
+        from elasticdl_tpu.checkpoint import delta as deltas
+        from elasticdl_tpu.checkpoint.saver import verify_integrity
+        import jax
+
+        old_gen = self.generation
+        try:
+            spec = faults.fire("serving.delta_apply")
+            if spec is not None and spec.kind == "error":
+                raise RuntimeError(
+                    f"FAULT INJECTION: delta apply failed ({spec.arg or 'error'})"
+                )
+            reason = verify_integrity(delta_dir)
+            if reason is not None:
+                deltas.quarantine_artifact(delta_dir, reason)
+                raise ValueError(f"corrupt delta {delta_dir}: {reason}")
+            loaded = deltas.load_delta(delta_dir)
+            manifest = loaded["manifest"]
+            if int(manifest["base_step"]) != old_gen.step:
+                raise ValueError(
+                    f"delta {delta_dir} chains from step "
+                    f"{manifest['base_step']} but generation "
+                    f"{old_gen.gen_id} serves step {old_gen.step}"
+                )
+            # Patch copies of the current host tables row-wise.
+            new_tables = {}
+            for key, (rows, vals, _meta) in loaded["tables"].items():
+                base = old_gen.served.tables.get(key)
+                if base is None:
+                    raise ValueError(
+                        f"delta {delta_dir} patches unknown table {key!r}"
+                    )
+                patched = np.array(base)
+                if rows.size:
+                    patched[rows] = vals
+                new_tables[key] = patched
+            # Resolve the delta's dense ref-tree against the patched
+            # tables (refs are "tables/<i>.npy" paths; index -> key via
+            # the manifest).
+            key_by_file = {
+                f"tables/{meta['index']}.npy": key
+                for key, (_r, _v, meta) in loaded["tables"].items()
+            }
+
+            def resolve(leaf):
+                if isinstance(leaf, dict) and "__table__" in leaf:
+                    key = key_by_file.get(leaf["__table__"])
+                    if key is None or key not in new_tables:
+                        raise ValueError(
+                            f"delta dense tree references unknown table "
+                            f"file {leaf['__table__']!r}"
+                        )
+                    return new_tables[key]
+                return leaf
+
+            from elasticdl_tpu.serving.export import _map_tree_with_refs
+
+            host_variables = _map_tree_with_refs(loaded["dense"], resolve)
+            variables = jax.device_put(host_variables, old_gen.shardings)
+            signature = dict(old_gen.served.signature)
+            signature["step"] = int(manifest["step"])
+            signature["event_time"] = float(manifest.get("event_time", 0.0))
+            served = ServingModel(
+                old_gen.served.model,
+                host_variables,
+                signature,
+                old_gen.served.base_dir,
+                tables=new_tables,
+            )
+            with self._lock:
+                if self._generation is not old_gen:
+                    raise RuntimeError(
+                        "generation changed under delta apply; re-resolve "
+                        "the chain"
+                    )
+                gen_id = self._next_gen_id
+                self._next_gen_id += 1
+            new_gen = Generation(
+                gen_id,
+                delta_dir,
+                served,
+                variables,
+                old_gen.serve_fn,  # same shapes+placement: reuse the compile
+                shardings=old_gen.shardings,
+                event_time=float(manifest.get("event_time", 0.0)),
+            )
+        except Exception as exc:
+            obs.journal().record(
+                "model_swap",
+                kind="delta",
+                outcome="rolled_back",
+                generation=old_gen.gen_id,
+                step=old_gen.step,
+                old_generation=old_gen.gen_id,
+                old_step=old_gen.step,
+                model_dir=delta_dir,
+                reason=repr(exc),
+            )
+            logger.exception(
+                "Delta apply from %s failed; generation %d (step %d) "
+                "keeps serving", delta_dir, old_gen.gen_id, old_gen.step,
+            )
+            raise
+        return self._swap(new_gen, delta_dir, kind="delta")
 
     # -- readouts --------------------------------------------------------
 
@@ -313,4 +475,7 @@ class ServingReplica:
             "inflight": gen.inflight(),
             "sparse_kernel": self._kernel,
             "devices": int(self._mesh.devices.size),
+            # Event-time frontier of the servable model: the freshness
+            # SLO's serving-side input (0.0 for pre-delta artifacts).
+            "model_event_time": gen.event_time,
         }

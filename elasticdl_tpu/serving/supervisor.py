@@ -109,6 +109,9 @@ def replica_argv_fn(
     queue_limit: int = 256,
     telemetry_interval_s: float = 1.0,
     warmup_features: str = "",
+    pub_dir: str = "",
+    pub_poll_interval_s: float = 2.0,
+    freshness_slo_s: float = 0.0,
     python: str = sys.executable,
 ) -> Callable[[int], List[str]]:
     """The pod manager's `worker_argv_fn` for serving replicas: the
@@ -129,6 +132,14 @@ def replica_argv_fn(
         ]
         if warmup_features:
             cmd += ["--warmup_features", warmup_features]
+        if pub_dir:
+            # Continuous serving: each replica tracks the delta chain
+            # itself (and evaluates the freshness SLO locally when set).
+            cmd += [
+                "--pub_dir", pub_dir,
+                "--pub_poll_interval_s", str(pub_poll_interval_s),
+                "--freshness_slo_s", str(freshness_slo_s),
+            ]
         return cmd
 
     return argv

@@ -106,6 +106,17 @@ EVENT_REQUIRED_FIELDS = {
     "serving_telemetry": ("replica_id",),
     "serving_replica_start": ("replica_id", "port"),
     "serving_fleet_start": ("replicas",),
+    # Continuous train->serve loop (master/stream.py, checkpoint/delta.py,
+    # obs/freshness.py — docs/design.md "Continuous training").
+    # `stream_watermark` records every advance of the trained-offset
+    # frontier (the journal-backed resume point for a SIGKILLed master);
+    # `delta_checkpoint`/`delta_compaction` are the chain's commit
+    # records; `freshness_slo` fires on breach/clear TRANSITIONS only,
+    # with the lag attributed to the owning stage.
+    "stream_watermark": ("stream", "offset"),
+    "delta_checkpoint": ("step", "base_step"),
+    "delta_compaction": ("step",),
+    "freshness_slo": ("state", "lag_s", "slo_s"),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -310,6 +321,23 @@ def _selftest() -> int:
         # phase_transition envelope with the REQUEST_PHASES taxonomy.
         {"ts": 7.22, "event": "phase_transition", "from": "queue",
          "to": "execute", "cause": "batch_formed", "seconds": 0.0021},
+        # Continuous train->serve loop.
+        {"ts": 7.24, "event": "stream_watermark", "stream": "clicks",
+         "offset": 81920, "event_time": 204.8, "next_offset": 86016,
+         "pending_ranges": 2},
+        {"ts": 7.25, "event": "delta_checkpoint", "step": 4160,
+         "base_step": 4096, "rows": 1812, "tables": 2,
+         "event_time": 204.8},
+        {"ts": 7.26, "event": "delta_compaction", "step": 4288,
+         "deltas_folded": 3, "event_time": 211.2},
+        {"ts": 7.27, "event": "freshness_slo", "state": "breach",
+         "lag_s": 12.4, "slo_s": 10.0, "stage": "serving",
+         "generation": 2, "step": 4160},
+        {"ts": 7.28, "event": "model_swap", "kind": "delta",
+         "outcome": "rolled_back", "generation": 2, "step": 4160,
+         "old_generation": 2, "old_step": 4160,
+         "model_dir": "/pub/delta_000000004160_000000004224",
+         "reason": "ValueError('corrupt delta')"},
         {"ts": 7.3, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
@@ -327,6 +355,10 @@ def _selftest() -> int:
         '{"ts": 1.493, "event": "serving_telemetry", "qps": 1}',  # no replica
         '{"ts": 1.494, "event": "serving_replica_start", "replica_id": 1}',
         '{"ts": 1.495, "event": "serving_fleet_start"}',        # no replicas
+        '{"ts": 1.496, "event": "stream_watermark", "stream": "clicks"}',
+        '{"ts": 1.497, "event": "delta_checkpoint", "step": 4160}',  # no base
+        '{"ts": 1.498, "event": "delta_compaction"}',           # no step
+        '{"ts": 1.499, "event": "freshness_slo", "state": "breach"}',
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

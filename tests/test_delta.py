@@ -1,0 +1,301 @@
+"""Delta-checkpoint chain tests (docs/design.md "Continuous training"):
+diff-based publish, CRC-manifested commit, torn-write quarantine with
+full-chain fallback, compaction repair, and the serving-side row-patch
+apply with atomic rollback."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.checkpoint.delta import (
+    DeltaExporter,
+    load_delta,
+    resolve_chain,
+    scan_pub_dir,
+)
+from elasticdl_tpu.common import faults
+from test_serving import _trained_deepfm
+
+_ZOO_ARGS = dict(
+    model_zoo="model_zoo",
+    model_def="deepfm.deepfm_functional_api",
+    model_params="vocab_size=100",
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = obs.init_journal(str(tmp_path))
+    try:
+        yield path
+    finally:
+        obs.journal().configure(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_scan_pub_dir_skips_tmp_and_quarantine(tmp_path):
+    for name in (
+        "full_000000000004",
+        "delta_000000000004_000000000006",
+        "delta.tmpabc123",
+        "publish.tmpdef",
+        "full_000000000002.quarantined",
+        "delta_000000000002_000000000004.quarantined.2",
+        "unrelated",
+    ):
+        os.makedirs(tmp_path / name)
+    fulls, deltas = scan_pub_dir(str(tmp_path))
+    assert fulls == [4]
+    assert deltas == [(4, 6)]
+
+
+def test_resolve_chain_empty_dir(tmp_path):
+    assert resolve_chain(str(tmp_path)) == (None, [])
+
+
+def test_delta_chain_publish_apply_compact(
+    tmp_path, journal_file, obs_registry_snapshot
+):
+    """The whole loop on one trainer: full -> delta (row diff, applied
+    in place without reload or recompile) -> compaction full."""
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    zoo, trainer, batches = _trained_deepfm(steps=2)
+    pub_dir = str(tmp_path / "pub")
+    exporter = DeltaExporter(pub_dir, **_ZOO_ARGS)
+    full_dir = exporter.publish_full(trainer, event_time=1.0)
+    base_step = exporter.head_step
+    assert os.path.basename(full_dir) == f"full_{base_step:012d}"
+
+    # No training since the full: a delta publish is a no-op.
+    assert exporter.publish_delta(trainer, event_time=1.0) is None
+
+    for feats_l, labels in batches[2:4]:
+        trainer.train_step(feats_l, labels)
+    delta_dir = exporter.publish_delta(trainer, event_time=2.0)
+    assert delta_dir is not None
+
+    # The diff is sparse: a 2-step minibatch touches well under the full
+    # vocabulary, and the stored rows reproduce the new table exactly.
+    loaded = load_delta(delta_dir)
+    manifest = loaded["manifest"]
+    assert manifest["base_step"] == base_step
+    assert manifest["step"] == exporter.head_step > base_step
+    sig = json.loads(
+        open(os.path.join(full_dir, "signature.json")).read()
+    )
+    assert sig["event_time"] == 1.0
+    for meta in sig["tables"]:
+        key = meta["key"]
+        base_table = np.load(os.path.join(full_dir, meta["file"]))
+        rows, vals, dmeta = loaded["tables"][key]
+        assert 0 < dmeta["rows"] < base_table.shape[0]
+        patched = np.array(base_table)
+        patched[rows] = vals
+        np.testing.assert_array_equal(patched, exporter._head[key])
+
+    # Chain resolution links the delta to its base.
+    assert resolve_chain(pub_dir) == (full_dir, [delta_dir])
+
+    # Serving side: load the full, apply the delta IN PLACE — same
+    # compiled step (no retrace), new generation, exact trainer parity.
+    replica = ServingReplica(full_dir, model_zoo="model_zoo")
+    old_gen = replica.generation
+    feats = {k: np.asarray(v) for k, v in batches[0][0].items()}
+    replica.apply_delta(delta_dir)
+    new_gen = replica.generation
+    assert new_gen.gen_id == old_gen.gen_id + 1
+    assert new_gen.step == manifest["step"]
+    assert new_gen.serve_fn is old_gen.serve_fn  # no recompile
+    assert new_gen.event_time == 2.0
+    np.testing.assert_allclose(
+        replica.execute(feats, n_valid=16),
+        np.asarray(trainer.eval_step(feats)),
+        rtol=1e-5,
+    )
+
+    # Compaction folds the head into a fresh full that re-anchors the
+    # chain (no deltas dangle past it).
+    compacted = exporter.compact()
+    assert os.path.basename(compacted) == f"full_{manifest['step']:012d}"
+    assert exporter.deltas_since_full == 0
+    base_dir, chain = resolve_chain(pub_dir)
+    assert base_dir == compacted and chain == []
+
+    events = _events(journal_file)
+    deltas = [e for e in events if e["event"] == "delta_checkpoint"]
+    assert len(deltas) == 1 and deltas[0]["base_step"] == base_step
+    assert deltas[0]["rows"] > 0 and deltas[0]["event_time"] == 2.0
+    compactions = [e for e in events if e["event"] == "delta_compaction"]
+    assert len(compactions) == 1 and compactions[0]["deltas_folded"] == 1
+    swaps = [e for e in events if e["event"] == "model_swap"]
+    assert [s["kind"] for s in swaps] == ["delta"]
+    assert swaps[0]["outcome"] == "applied" and swaps[0]["undrained"] == 0
+
+
+def test_torn_delta_quarantined_and_compaction_repairs(
+    tmp_path, journal_file, obs_registry_snapshot
+):
+    """The `ckpt.delta` fault tears the largest delta file after its
+    checksum is manifested: resolve_chain proves the corruption, moves
+    the link aside, and the chain falls back to the last full — until a
+    compaction republishes past the gap."""
+    zoo, trainer, batches = _trained_deepfm(steps=2)
+    pub_dir = str(tmp_path / "pub")
+    exporter = DeltaExporter(pub_dir, **_ZOO_ARGS)
+    full_dir = exporter.publish_full(trainer, event_time=1.0)
+
+    faults.install("ckpt.delta:truncate@1")
+    for feats, labels in batches[2:4]:
+        trainer.train_step(feats, labels)
+    torn_dir = exporter.publish_delta(trainer, event_time=2.0)
+    head_after_torn = exporter.head_step
+    faults.clear()
+
+    # The consumer proves the tear and quarantines; the chain degrades
+    # to the last good full (stale-serving, never down).
+    base_dir, chain = resolve_chain(pub_dir)
+    assert base_dir == full_dir and chain == []
+    assert not os.path.exists(torn_dir)
+    assert os.path.exists(torn_dir + ".quarantined")
+    quarantined = [
+        e for e in _events(journal_file)
+        if e["event"] == "checkpoint_quarantined"
+    ]
+    assert len(quarantined) == 1
+    assert quarantined[0]["path"] == torn_dir
+    assert "torn write" in quarantined[0]["reason"]
+
+    # The exporter's head mirrors the TRAINER (it advanced through the
+    # torn publish), so compaction repairs the gap at the head step —
+    # and the repaired full is built from the pristine in-memory head,
+    # not the torn bytes on disk: it must load and match the trainer.
+    compacted = exporter.compact()
+    base_dir, chain = resolve_chain(pub_dir)
+    assert base_dir == compacted and chain == []
+    assert exporter.head_step == head_after_torn
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    replica = ServingReplica(compacted, model_zoo="model_zoo")
+    probe = {k: np.asarray(v) for k, v in batches[0][0].items()}
+    np.testing.assert_allclose(
+        replica.execute(probe, n_valid=16),
+        np.asarray(trainer.eval_step(probe)),
+        rtol=1e-5,
+    )
+    # A later delta chains from the compacted full, not the torn link.
+    for feats, labels in batches[0:2]:
+        trainer.train_step(feats, labels)
+    next_delta = exporter.publish_delta(trainer, event_time=3.0)
+    base_dir, chain = resolve_chain(pub_dir)
+    assert base_dir == compacted and chain == [next_delta]
+
+
+def test_corrupt_full_falls_back_to_previous(
+    tmp_path, journal_file, obs_registry_snapshot
+):
+    zoo, trainer, batches = _trained_deepfm(steps=2)
+    pub_dir = str(tmp_path / "pub")
+    exporter = DeltaExporter(pub_dir, **_ZOO_ARGS)
+    old_full = exporter.publish_full(trainer, event_time=1.0)
+    for feats, labels in batches[2:4]:
+        trainer.train_step(feats, labels)
+    new_full = exporter.publish_full(trainer, event_time=2.0)
+
+    # Same-size bit flip in the newest full: crc catches it, the walk
+    # falls back one full instead of failing the resolve.
+    victim = os.path.join(new_full, "variables.pkl")
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+
+    base_dir, chain = resolve_chain(pub_dir)
+    assert base_dir == old_full and chain == []
+    assert os.path.exists(new_full + ".quarantined")
+    reasons = [
+        e["reason"] for e in _events(journal_file)
+        if e["event"] == "checkpoint_quarantined"
+    ]
+    assert len(reasons) == 1 and "crc32" in reasons[0]
+
+
+def test_delta_apply_fault_rolls_back_then_retries(
+    tmp_path, journal_file, obs_registry_snapshot
+):
+    """The `serving.delta_apply` fault: the FIRST apply fails and rolls
+    back atomically (old generation keeps answering, journaled
+    rolled_back); the watcher's next poll retries the same link and
+    succeeds — the stale-serving rung is temporary by construction."""
+    from elasticdl_tpu.serving.continuous import DeltaWatcher
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    zoo, trainer, batches = _trained_deepfm(steps=2)
+    pub_dir = str(tmp_path / "pub")
+    exporter = DeltaExporter(pub_dir, **_ZOO_ARGS)
+    full_dir = exporter.publish_full(trainer, event_time=1.0)
+    for feats, labels in batches[2:4]:
+        trainer.train_step(feats, labels)
+    delta_dir = exporter.publish_delta(trainer, event_time=2.0)
+
+    replica = ServingReplica(full_dir, model_zoo="model_zoo")
+    old_gen = replica.generation
+    feats = {k: np.asarray(v) for k, v in batches[0][0].items()}
+    baseline = replica.execute(feats, n_valid=16)
+
+    faults.install("serving.delta_apply:error=injected@1")
+    watcher = DeltaWatcher(replica, pub_dir)
+    summary = watcher.poll_once()
+    assert summary["failed"] == delta_dir
+    assert summary["applied_deltas"] == 0
+    # Rolled back: same generation object, still answering, same bits.
+    assert replica.generation is old_gen
+    np.testing.assert_array_equal(
+        replica.execute(feats, n_valid=16), baseline
+    )
+
+    summary = watcher.poll_once()  # fault exhausted: the retry lands
+    assert summary["failed"] is None and summary["applied_deltas"] == 1
+    assert replica.generation.step == exporter.head_step
+
+    swaps = [e for e in _events(journal_file) if e["event"] == "model_swap"]
+    assert [s["outcome"] for s in swaps] == ["rolled_back", "applied"]
+    assert swaps[0]["kind"] == "delta"
+    assert "injected" in swaps[0]["reason"]
+    assert swaps[0]["generation"] == old_gen.gen_id  # pointer never moved
+
+
+def test_delta_apply_rejects_chain_gap(tmp_path, obs_registry_snapshot):
+    """A delta whose base_step is not the serving step is a gap: apply
+    refuses (rolled back) rather than patching rows into the wrong
+    base — the watcher waits for compaction instead."""
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    zoo, trainer, batches = _trained_deepfm(steps=2)
+    pub_dir = str(tmp_path / "pub")
+    exporter = DeltaExporter(pub_dir, **_ZOO_ARGS)
+    full_dir = exporter.publish_full(trainer, event_time=1.0)
+    for feats, labels in batches[2:4]:
+        trainer.train_step(feats, labels)
+    exporter.publish_delta(trainer, event_time=2.0)
+    for feats, labels in batches[0:2]:
+        trainer.train_step(feats, labels)
+    second_delta = exporter.publish_delta(trainer, event_time=3.0)
+
+    replica = ServingReplica(full_dir, model_zoo="model_zoo")
+    with pytest.raises(ValueError, match="chains from step"):
+        replica.apply_delta(second_delta)
+    assert replica.generation.gen_id == 1

@@ -150,6 +150,55 @@ def test_due_disarmed_registry_is_empty():
 
 
 # ---------------------------------------------------------------------------
+# Continuous train->serve loop sites (docs/failure_model.md): the specs
+# the chaos e2e installs.  Site semantics are exercised end-to-end in
+# test_stream.py / test_delta.py; here we pin the spec grammar.
+# ---------------------------------------------------------------------------
+
+
+def test_parse_continuous_loop_sites():
+    specs = faults.parse_specs(
+        "stream.source:latency=1.5@t2.0,"
+        " ckpt.delta:truncate@2,"
+        " serving.delta_apply:error=boom@3"
+    )
+    stall, torn, apply_fail = specs
+
+    # Source stall: schedule-triggered latency the driver converts into
+    # stream.stall(arg) — availability shifts, event-time does not.
+    assert stall.site == "stream.source"
+    assert stall.kind == "latency"
+    assert stall.arg == "1.5" and float(stall.arg) == 1.5
+    assert stall.at_s == 2.0
+    assert stall.triggers_at(1) is False  # schedule path only
+
+    # Torn delta: fires on the Nth publish, after the checksum is
+    # manifested — the consumer must prove and quarantine it.
+    assert torn.site == "ckpt.delta"
+    assert torn.kind == "truncate"
+    assert torn.at_s is None and torn.triggers_at(2)
+    assert not torn.triggers_at(1) and not torn.triggers_at(3)
+
+    # Failed apply: raises inside apply_delta, forcing the atomic
+    # rollback; exhausted after one firing so the retry lands.
+    assert apply_fail.site == "serving.delta_apply"
+    assert apply_fail.kind == "error"
+    assert apply_fail.arg == "boom"
+    assert apply_fail.triggers_at(3) and not apply_fail.triggers_at(4)
+
+
+def test_continuous_loop_sites_fire_independently():
+    faults.install(
+        "ckpt.delta:truncate@1, serving.delta_apply:error=injected@1"
+    )
+    assert faults.fire("ckpt.delta").kind == "truncate"
+    assert faults.fire("ckpt.delta") is None  # exhausted
+    hit = faults.fire("serving.delta_apply")
+    assert hit.kind == "error" and hit.arg == "injected"
+    assert faults.fire("stream.source") is None  # never installed
+
+
+# ---------------------------------------------------------------------------
 # Integrity manifest helpers
 # ---------------------------------------------------------------------------
 

@@ -180,6 +180,7 @@ def test_format_dict_params_round_trip():
 # ---------------------------------------------------------------------------
 
 import importlib.util
+import shutil
 import threading
 import time
 
@@ -454,6 +455,83 @@ def test_hot_swap_equivalence(tmp_path, journal_file, obs_registry_snapshot):
     assert swaps[0]["generation"] == 2
     assert swaps[0]["old_generation"] == 1
     assert swaps[0]["undrained"] == 0
+
+
+def test_reload_corrupt_artifact_keeps_serving(
+    tmp_path, journal_file, obs_registry_snapshot
+):
+    """Reload hardening (continuous-loop degradation ladder): a corrupt
+    artifact fails the reload BEFORE the generation pointer moves — no
+    half-built generation — while live traffic rides the old generation
+    through the failure with zero dropped requests, and the rollback is
+    journaled.  A good artifact then swaps in normally."""
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    trainer, batches, gen1_dir, feats, expected1 = _exported_deepfm(tmp_path)
+    for f, labels in batches[2:4]:
+        trainer.train_step(f, labels)
+    gen2_dir = str(tmp_path / "gen2")
+    export_model(
+        trainer, gen2_dir,
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm_functional_api",
+        model_params="vocab_size=100",
+    )
+    # Corrupt the new artifact's variables mid-pipeline (a torn copy).
+    corrupt = str(tmp_path / "gen2_corrupt")
+    shutil.copytree(gen2_dir, corrupt)
+    with open(os.path.join(corrupt, "variables.pkl"), "r+b") as fh:
+        fh.truncate(os.path.getsize(os.path.join(corrupt, "variables.pkl")) // 2)
+
+    replica = ServingReplica(gen1_dir, model_zoo="model_zoo")
+    old_gen = replica.generation
+    baseline = replica.execute(feats, n_valid=16)
+
+    served = []
+    errors = []
+    stop = threading.Event()
+
+    def loadgen():
+        while not stop.is_set():
+            try:
+                served.append(replica.execute(feats, n_valid=16))
+            except Exception as exc:  # pragma: no cover - the failure mode
+                errors.append(exc)
+                return
+
+    thread = threading.Thread(target=loadgen, daemon=True)
+    thread.start()
+    try:
+        with pytest.raises(Exception):
+            replica.reload(corrupt)
+        # Pointer untouched: SAME generation object, still answering.
+        assert replica.generation is old_gen
+        assert replica.generation.gen_id == 1
+        np.testing.assert_array_equal(
+            replica.execute(feats, n_valid=16), baseline
+        )
+    finally:
+        stop.set()
+        thread.join(timeout=30)
+    assert not errors, f"requests dropped during failed reload: {errors}"
+    assert len(served) > 0
+    for out in served:
+        np.testing.assert_array_equal(out, baseline)
+
+    # The rollback is journaled; a good artifact still swaps in after.
+    swaps = [e for e in _events(journal_file) if e["event"] == "model_swap"]
+    assert [s["outcome"] for s in swaps] == ["rolled_back"]
+    assert swaps[0]["kind"] == "full"
+    assert swaps[0]["generation"] == 1 and swaps[0]["model_dir"] == corrupt
+    replica.reload(gen2_dir)
+    assert replica.generation.gen_id > 1
+    np.testing.assert_allclose(
+        replica.execute(feats, n_valid=16),
+        np.asarray(trainer.eval_step(feats)),
+        rtol=1e-5,
+    )
+    swaps = [e for e in _events(journal_file) if e["event"] == "model_swap"]
+    assert swaps[-1]["outcome"] == "applied" and swaps[-1]["undrained"] == 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,373 @@
+"""Continuous-training stream tests (docs/design.md "Continuous
+training"): the deterministic stream source's schedule math, the
+streaming task dispatcher's watermark-based eviction, and the two
+crash-safe resume paths (progress snapshot, journal replay)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.data.stream import (
+    SyntheticClickStream,
+    iter_stream_batches,
+    synthetic_click_batch,
+)
+from elasticdl_tpu.master.stream import StreamingTaskManager
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = obs.init_journal(str(tmp_path))
+    try:
+        yield path
+    finally:
+        obs.journal().configure(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# SyntheticClickStream: schedule math on a driver-owned virtual clock
+# ---------------------------------------------------------------------------
+
+
+def test_stream_schedule_integration_and_spike():
+    stream = SyntheticClickStream([(4.0, 100), (2.0, 400)], name="clicks")
+    assert stream.available() == 0
+    stream.advance(2.0)
+    assert stream.available() == 200
+    stream.advance(2.0)  # end of phase 1
+    assert stream.available() == 400
+    # Rate spike: the second phase produces 4x per second, and the LAST
+    # phase's rate continues forever (a stream has no end).
+    stream.advance(2.0)
+    assert stream.available() == 400 + 800
+    stream.advance(3.0)
+    assert stream.available() == 400 + 800 + 1200
+
+
+def test_stream_event_time_inverts_schedule():
+    stream = SyntheticClickStream([(4.0, 100), (2.0, 400)])
+    assert stream.event_time(0) == 0.0
+    assert stream.event_time(200) == pytest.approx(2.0)
+    assert stream.event_time(400) == pytest.approx(4.0)
+    # Into the spike phase: 800 records past the boundary at 400/s.
+    assert stream.event_time(400 + 800) == pytest.approx(6.0)
+    # records_until / event_time are inverses on phase-interior points,
+    # up to the floor at the integer record count (float division may
+    # land an ulp under the exact boundary).
+    for offset in (1, 57, 399, 401, 999):
+        assert stream.records_until(stream.event_time(offset)) in (
+            offset - 1, offset,
+        )
+
+
+def test_stream_stall_shifts_availability_not_event_time():
+    stream = SyntheticClickStream([(10.0, 100)])
+    stream.advance(4.0)
+    before = stream.available()
+    stream.stall(2.0)
+    # A wedged pipe delays ARRIVAL: availability rewinds by the stall...
+    assert stream.available() == before - 200
+    # ...but event times are intrinsic to the records (minted upstream).
+    assert stream.event_time(100) == pytest.approx(1.0)
+    # Production catches back up once the stall has been ridden out.
+    stream.advance(2.0)
+    assert stream.available() == before
+
+
+def test_stream_source_fault_site_stalls_on_call_count():
+    faults.install("stream.source:latency=3.0@2")
+    stream = SyntheticClickStream([(10.0, 100)])
+    stream.advance(1.0)  # call 1: no fault
+    assert stream.available() == 100
+    stream.advance(1.0)  # call 2: wedged for 3.0 virtual seconds
+    assert stream.available() == 0
+    stream.advance(4.0)
+    assert stream.available() == 300
+
+
+def test_stream_source_schedule_spec_via_due():
+    # The @t form never fires through advance(); a driver polling its own
+    # elapsed time applies it (the chaos-e2e discipline).
+    faults.install("stream.source:latency=2.0@t1.5")
+    stream = SyntheticClickStream([(10.0, 100)])
+    stream.advance(1.0)
+    assert faults.due("stream.source", stream.elapsed_s) == []
+    stream.advance(1.0)
+    (spec,) = faults.due("stream.source", stream.elapsed_s)
+    stream.stall(float(spec.arg))
+    assert stream.available() == 0
+    assert faults.remaining_due("stream.source") == 0
+
+
+def test_stream_json_round_trip():
+    stream = SyntheticClickStream([(4.0, 100), (2.0, 400)], name="clicks")
+    stream.advance(3.0)
+    stream.stall(0.5)
+    stream.close()
+    clone = SyntheticClickStream.from_json(stream.to_json())
+    assert clone.name == "clicks"
+    assert clone.closed
+    assert clone.available() == stream.available()
+    assert clone.event_time(123) == stream.event_time(123)
+
+
+def test_stream_rejects_bad_schedules():
+    with pytest.raises(ValueError):
+        SyntheticClickStream([])
+    with pytest.raises(ValueError):
+        SyntheticClickStream([(4.0, -1)])
+    with pytest.raises(ValueError):
+        SyntheticClickStream([(4.0, 100), (2.0, 0)])  # endless zero rate
+    stream = SyntheticClickStream([(1.0, 10)])
+    with pytest.raises(ValueError):
+        stream.advance(-0.1)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic record batches: the at-least-once data contract
+# ---------------------------------------------------------------------------
+
+
+def test_synthetic_click_batch_is_offset_pure():
+    whole = synthetic_click_batch(0, 100, vocab_size=50)
+    part = synthetic_click_batch(40, 60, vocab_size=50)
+    for name in whole:
+        # A replayed sub-range is bit-identical to its slice of the
+        # original: requeued tasks retrain on the SAME records.
+        np.testing.assert_array_equal(part[name], whole[name][40:60])
+        assert whole[name].dtype == np.int64
+        assert whole[name].min() >= 0 and whole[name].max() < 50
+    # Distinct fields decorrelate (different stride per field).
+    assert not np.array_equal(whole["user"], whole["item"])
+
+
+def test_iter_stream_batches_windows_and_tail():
+    seen = list(
+        iter_stream_batches(
+            lambda lo, hi: (lo, hi), lo=10, hi=45, batch_size=16
+        )
+    )
+    assert seen == [(10, 26), (26, 42), (42, 45)]
+
+
+# ---------------------------------------------------------------------------
+# StreamingTaskManager: dispatch, watermark eviction, backpressure
+# ---------------------------------------------------------------------------
+
+
+def _manager(stream, rpt=10, lookahead=3, **kw):
+    return StreamingTaskManager(
+        stream, records_per_task=rpt, lookahead_tasks=lookahead, **kw
+    )
+
+
+def test_streaming_dispatch_and_watermark_eviction(journal_file):
+    stream = SyntheticClickStream([(10.0, 10)], name="clicks")
+    stream.advance(10.0)  # 100 records available
+    manager = _manager(stream, rpt=10, lookahead=3)
+
+    # Bounded lookahead: at most 3 tasks in existence (todo + doing).
+    tasks = [manager.get(worker_id=1) for _ in range(3)]
+    assert [(t.start, t.end) for t in tasks] == [(0, 10), (10, 20), (20, 30)]
+    assert all(t.shard_name == "clicks" for t in tasks)
+    wait = manager.get(worker_id=1)
+    assert wait.type == pb.WAIT  # backpressure, never job-complete
+
+    # Out-of-order completion: a hole above the watermark does not
+    # advance it; closing the prefix evicts the whole contiguous run.
+    assert manager.report(tasks[2].task_id, success=True, worker_id=1)
+    assert manager.watermark == 0
+    assert manager.report(tasks[0].task_id, success=True, worker_id=1)
+    assert manager.watermark == 10
+    assert manager.report(tasks[1].task_id, success=True, worker_id=1)
+    assert manager.watermark == 30
+    assert manager.stream_counts()["pending_ranges"] == 0
+
+    marks = [e for e in _events(journal_file) if e["event"] == "stream_watermark"]
+    assert [m["offset"] for m in marks] == [10, 30]
+    assert all(m["stream"] == "clicks" for m in marks)
+    # Watermark event time rides the schedule inverse.
+    assert marks[-1]["event_time"] == pytest.approx(3.0)
+    assert manager.watermark_event_time() == pytest.approx(3.0)
+
+
+def test_streaming_partial_tail_waits_for_close():
+    stream = SyntheticClickStream([(10.0, 10)], name="clicks")
+    stream.advance(2.5)  # 25 records: two full tasks + a 5-record tail
+    manager = _manager(stream, rpt=10, lookahead=8)
+    t1 = manager.get(1)
+    t2 = manager.get(1)
+    assert (t1.start, t1.end, t2.start, t2.end) == (0, 10, 10, 20)
+    # Open stream: the partial tail waits to fill (uniform cuts).
+    assert manager.get(1).type == pb.WAIT
+    manager.report(t1.task_id, True, worker_id=1)
+    manager.report(t2.task_id, True, worker_id=1)
+    assert not manager.finished()
+
+    stream.close()
+    t3 = manager.get(1)
+    assert (t3.start, t3.end) == (20, 25)
+    manager.report(t3.task_id, True, worker_id=1)
+    assert manager.watermark == 25
+    # Drained and closed: the done protocol ran at the final report,
+    # so the next poll is job-complete (never before close()).
+    done = manager.get(1)
+    assert done.task_id == -1 and done.type != pb.WAIT
+    assert manager.finished()
+
+
+def test_streaming_churn_requeue_rides_existing_path(journal_file):
+    stream = SyntheticClickStream([(10.0, 10)], name="clicks")
+    stream.advance(4.0)
+    manager = _manager(stream, rpt=10, lookahead=4)
+    victim = manager.get(worker_id=7)
+    survivor = manager.get(worker_id=1)
+    assert manager.recover_tasks(worker_id=7) == 1
+    assert manager.recovered_record_count == 10
+
+    # The requeued range re-dispatches first (appendleft) and completes;
+    # watermark accounting is unaffected by the churn.
+    retry = manager.get(worker_id=1)
+    assert (retry.start, retry.end) == (victim.start, victim.end)
+    manager.report(retry.task_id, True, worker_id=1)
+    manager.report(survivor.task_id, True, worker_id=1)
+    assert manager.watermark == 20
+    requeues = [e for e in _events(journal_file) if e["event"] == "task_requeue"]
+    assert requeues and requeues[0]["reason"] == "worker_churn"
+
+
+def test_streaming_failure_retry_and_watermark(journal_file):
+    stream = SyntheticClickStream([(10.0, 10)], name="clicks")
+    stream.advance(2.0)
+    manager = _manager(stream, rpt=10, lookahead=2, max_task_retries=2)
+    task = manager.get(1)
+    assert not manager.watermark
+    manager.report(task.task_id, success=False, worker_id=1)
+    retry = manager.get(1)
+    assert (retry.start, retry.end) == (task.start, task.end)
+    manager.report(retry.task_id, success=True, worker_id=1)
+    assert manager.watermark == 10
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe resume: progress snapshot and journal replay
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_checkpoint_resume_mid_stream(journal_file):
+    stream = SyntheticClickStream([(10.0, 10)], name="clicks")
+    stream.advance(6.0)
+    manager = _manager(stream, rpt=10, lookahead=4)
+    tasks = [manager.get(worker_id=1) for _ in range(4)]
+    # Complete 0 and 2: watermark 10, hole [20, 30) above it; 1 and 3
+    # in flight at the "crash".
+    manager.report(tasks[0].task_id, True, worker_id=1)
+    manager.report(tasks[2].task_id, True, worker_id=1)
+    snapshot = manager.to_checkpoint()
+
+    state = json.loads(snapshot)
+    assert state["stream"]["watermark"] == 10
+    assert state["stream"]["completed"] == [[20, 30]]
+    assert state["stream"]["source"]["name"] == "clicks"
+
+    resumed = StreamingTaskManager.from_checkpoint(snapshot)
+    assert resumed.watermark == 10
+    counts = resumed.stream_counts()
+    assert counts["pending_ranges"] == 1
+    # In-flight ranges were folded into todo (at-least-once); the
+    # completed hole never re-emits.
+    redo = []
+    while True:
+        task = resumed.get(worker_id=2)
+        if task.type == pb.WAIT or task.task_id == -1:
+            break
+        redo.append((task.start, task.end))
+        resumed.report(task.task_id, True, worker_id=2)
+    assert (10, 20) in redo and (30, 40) in redo
+    assert all(not (lo >= 20 and hi <= 30) for lo, hi in redo)
+    assert resumed.watermark == 60  # drained the 60 available records
+
+
+def test_streaming_resume_from_journal_redo_exact(journal_file):
+    stream = SyntheticClickStream([(10.0, 10)], name="clicks")
+    stream.advance(6.0)
+    manager = _manager(stream, rpt=10, lookahead=4)
+    tasks = [manager.get(worker_id=1) for _ in range(4)]
+    manager.report(tasks[0].task_id, True, worker_id=1)
+    manager.report(tasks[2].task_id, True, worker_id=1)
+    # Master SIGKILL: no snapshot, only the journal survives.
+    del manager
+
+    events = _events(journal_file)
+    resumed = StreamingTaskManager.resume_from_journal(
+        events,
+        SyntheticClickStream.from_json(stream.to_json()),
+        records_per_task=10,
+        lookahead_tasks=4,
+    )
+    assert resumed.watermark == 10
+    assert resumed.stream_counts()["pending_ranges"] == 1
+    assert resumed.finished_record_count == 20  # watermark + the hole
+
+    # Redo debt is EXACT: precisely the two ranges in flight at the kill
+    # re-cut; the completed hole [20, 30) never re-emits.
+    redo = []
+    while True:
+        task = resumed.get(worker_id=2)
+        if task.type == pb.WAIT or task.task_id == -1:
+            break
+        redo.append((task.start, task.end))
+        resumed.report(task.task_id, True, worker_id=2)
+    assert redo[:2] == [(10, 20), (30, 40)]
+    assert all(not (lo >= 20 and hi <= 30) for lo, hi in redo)
+    assert resumed.watermark == 60
+
+    # The resume itself is journaled with the stream cursor.
+    resumes = [
+        e for e in _events(journal_file)
+        if e["event"] == "task_progress_resume"
+    ]
+    assert resumes and resumes[-1]["watermark"] == 10
+    assert resumes[-1]["completed_above_watermark"] == 1
+
+
+def test_streaming_resume_from_journal_contiguous_prefix_advances():
+    # Every dispatched range completed before the kill, but the LAST
+    # watermark journal write raced the crash: the done chain above the
+    # journaled watermark must fold in at resume, not re-emit.
+    stream = SyntheticClickStream([(10.0, 10)], name="clicks")
+    stream.advance(5.0)  # 50 available: records exist past the done chain
+    events = [
+        {"event": "stream_watermark", "stream": "clicks", "offset": 10,
+         "event_time": 1.0, "next_offset": 30, "pending_ranges": 0},
+        {"event": "task_dispatch", "task_id": 2, "shard": "clicks",
+         "start": 10, "end": 20, "worker_id": 1},
+        {"event": "task_dispatch", "task_id": 3, "shard": "clicks",
+         "start": 20, "end": 30, "worker_id": 1},
+        {"event": "task_done", "task_id": 2},
+        {"event": "task_done", "task_id": 3},
+    ]
+    resumed = StreamingTaskManager.resume_from_journal(
+        events, stream, records_per_task=10
+    )
+    assert resumed.watermark == 30
+    assert resumed.stream_counts()["pending_ranges"] == 0
+    assert resumed.finished_record_count == 30
+    task = resumed.get(worker_id=1)
+    assert task.start == 30  # the frontier resumes past everything done

@@ -1,0 +1,343 @@
+"""Chaos e2e for the continuous train->serve loop (ISSUE 16 acceptance).
+
+One deterministic in-process driver runs the whole loop on a virtual
+clock: an unbounded synthetic click stream with a mid-run rate spike,
+the master's streaming dispatcher, three training "workers", the delta
+publisher, and a serving replica advanced by a DeltaWatcher under live
+loadgen traffic — while the fault plane injects every new site:
+
+  stream.source       schedule-based stall (wedged upstream pipe)
+  worker churn        trained-but-unreported tasks requeued
+  master SIGKILL      dispatcher rebuilt from the journal mid-stream
+  ckpt.delta          torn delta write, quarantined by the consumer
+  serving.delta_apply failed apply, atomic rollback, retried next poll
+
+Everything is virtual time (`SyntheticClickStream.advance` +
+`faults.due`), so the run replays bit-exactly.  The acceptance
+assertions at the bottom are the ISSUE's: redo debt exact, zero dropped
+requests, quarantine + rollback journaled, freshness SLO breached then
+defended, journal schema-valid.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.checkpoint.delta import DeltaExporter
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.data.stream import SyntheticClickStream
+from elasticdl_tpu.master.stream import StreamingTaskManager
+from elasticdl_tpu.obs.freshness import FreshnessTracker
+from test_serving import _trained_deepfm
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+pytestmark = [pytest.mark.slow, pytest.mark.e2e]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = obs.init_journal(str(tmp_path))
+    try:
+        yield path
+    finally:
+        obs.journal().configure(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_journal",
+        os.path.join(REPO_ROOT, "scripts", "validate_journal.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["validate_journal"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _merged_cover(ranges):
+    merged = []
+    for lo, hi in sorted(ranges):
+        if merged and lo <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    return [tuple(r) for r in merged]
+
+
+def test_continuous_loop_chaos_e2e(
+    tmp_path, journal_file, obs_registry_snapshot
+):
+    from elasticdl_tpu.serving.continuous import DeltaWatcher
+    from elasticdl_tpu.serving.runtime import ServingReplica
+
+    # ------------------------------------------------------------------
+    # World: deepfm trainer, pub dir, 6s virtual run at 0.25s ticks.
+    # Phase 1 produces 400 rec/s for 4s, then a 4x spike forever.
+    # ------------------------------------------------------------------
+    zoo, trainer, batches = _trained_deepfm(steps=2)
+    pool = batches  # task range -> deterministic minibatch
+    pub_dir = str(tmp_path / "pub")
+    exporter = DeltaExporter(
+        pub_dir,
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm_functional_api",
+        model_params="vocab_size=100",
+    )
+    stream = SyntheticClickStream(
+        [(4.0, 400.0), (2.0, 1600.0)], name="clicks"
+    )
+    manager = StreamingTaskManager(
+        stream, records_per_task=64, lookahead_tasks=8
+    )
+    tracker = FreshnessTracker(slo_s=1.5)
+    faults.install(
+        "stream.source:latency=1.0@t2.0,"
+        " ckpt.delta:truncate@2,"
+        " serving.delta_apply:error=injected@3"
+    )
+
+    DT = 0.25
+    train_counts = {}
+
+    def train(task):
+        feats, labels = pool[(task.start // 64) % len(pool)]
+        trainer.train_step(feats, labels)
+        key = (task.start, task.end)
+        train_counts[key] = train_counts.get(key, 0) + 1
+
+    def drain_worker(worker_id, budget=64):
+        for _ in range(budget):
+            task = manager.get(worker_id)
+            if task.task_id < 0:
+                return
+            train(task)
+            manager.report(task.task_id, True, worker_id=worker_id)
+
+    replica = None
+    watcher = None
+    served, serve_errors = [], []
+    stop_loadgen = threading.Event()
+    feats = {k: np.asarray(v) for k, v in batches[0][0].items()}
+
+    def loadgen():
+        while not stop_loadgen.is_set():
+            try:
+                served.append(np.asarray(replica.execute(feats, n_valid=16)))
+            except Exception as exc:  # any dip is a test failure
+                serve_errors.append(exc)
+                return
+            time.sleep(0.001)
+
+    loadgen_thread = threading.Thread(target=loadgen, daemon=True)
+
+    def publish_delta():
+        delta_dir = exporter.publish_delta(
+            trainer, event_time=manager.watermark_event_time()
+        )
+        if delta_dir is not None:
+            tracker.note_published(
+                exporter.head_step, manager.watermark_event_time()
+            )
+        return delta_dir
+
+    churned = []
+    rolled_back_seen = False
+    killed_inflight = []
+
+    try:
+        for i in range(24):
+            stream.advance(DT)
+            now = stream.elapsed_s
+            # Schedule-based source stall: the driver owns the timeline,
+            # so it converts due specs into stream.stall itself.
+            for spec in faults.due("stream.source", now):
+                if spec.kind == "latency":
+                    stream.stall(float(spec.arg or 1.0))
+
+            if i == 17:
+                # Master SIGKILL mid-stream: some tasks are dispatched
+                # (in flight, never trained, never reported) when the
+                # process dies.  The journal is all that survives.
+                for w in (0, 1):
+                    task = manager.get(w)
+                    if task.task_id >= 0:
+                        killed_inflight.append((task.start, task.end))
+                assert killed_inflight, "kill tick dispatched nothing"
+                watermark_before = manager.watermark
+                del manager
+                manager = StreamingTaskManager.resume_from_journal(
+                    _events(journal_file),
+                    stream,
+                    records_per_task=64,
+                    lookahead_tasks=8,
+                )
+                assert manager.watermark == watermark_before
+
+            if i == 3:  # t=1.0: seed the chain, bring serving up
+                full_dir = exporter.publish_full(
+                    trainer, event_time=manager.watermark_event_time()
+                )
+                tracker.note_published(
+                    exporter.head_step, manager.watermark_event_time()
+                )
+                replica = ServingReplica(full_dir, model_zoo="model_zoo")
+                watcher = DeltaWatcher(replica, pub_dir, freshness=tracker)
+                gen = replica.generation
+                tracker.note_served(gen.gen_id, gen.step, gen.event_time)
+                loadgen_thread.start()
+            elif i == 7:  # t=2.0: first delta (applies cleanly)
+                assert publish_delta() is not None
+            elif i == 13:  # t=3.5: second delta (torn by ckpt.delta@2);
+                # the source stall froze the cut frontier until ~t=3.0,
+                # so this is the first publish with fresh training on it
+                assert publish_delta() is not None
+            elif i == 15:  # t=4.0: compaction repairs the quarantine gap
+                compacted = exporter.compact()
+                assert compacted is not None
+                tracker.note_published(
+                    exporter.head_step, manager.watermark_event_time()
+                )
+            elif i == 18:  # t=4.75: post-resume delta (applies cleanly)
+                assert publish_delta() is not None
+            elif i == 20:  # t=5.25: delta whose apply faults then retries
+                assert publish_delta() is not None
+
+            if i == 5:
+                # Worker churn: worker 2 trains tasks but is SIGKILLed
+                # before reporting — recover_tasks requeues them, and the
+                # replay is the ONLY redo debt this run may carry.
+                for _ in range(2):
+                    task = manager.get(2)
+                    if task.task_id < 0:
+                        break
+                    train(task)
+                    churned.append((task.start, task.end))
+                assert churned, "churn tick dispatched nothing"
+                assert manager.recover_tasks(2) == len(churned)
+
+            for w in (0, 1, 2):
+                drain_worker(w)
+
+            if watcher is not None:
+                summary = watcher.poll_once()
+                if summary["failed"] is not None:
+                    rolled_back_seen = True
+            tracker.note_watermark(manager.watermark_event_time())
+            tracker.evaluate(now)
+
+        # --------------------------------------------------------------
+        # Drain: close the source, train the tail, publish the final
+        # state, and let serving catch all the way up.
+        # --------------------------------------------------------------
+        stream.close()
+        for _ in range(100):
+            if manager.finished():
+                break
+            for w in (0, 1, 2):
+                drain_worker(w)
+        assert manager.finished()
+        publish_delta()
+        for _ in range(4):
+            if replica.generation.step == exporter.head_step:
+                break
+            watcher.poll_once()
+        tracker.note_watermark(manager.watermark_event_time())
+        tracker.evaluate(stream.elapsed_s)
+    finally:
+        stop_loadgen.set()
+        if loadgen_thread.is_alive():
+            loadgen_thread.join(timeout=30)
+
+    # ------------------------------------------------------------------
+    # Acceptance: redo debt exact — every record trained, duplicates are
+    # EXACTLY the churn-requeued ranges (master kill added none: its
+    # in-flight tasks were never trained, so the resume re-cut them and
+    # they trained once).
+    # ------------------------------------------------------------------
+    total = stream.available()
+    counts = manager.stream_counts()
+    assert counts["watermark"] == total
+    assert counts["pending_ranges"] == 0
+    assert _merged_cover(train_counts) == [(0, total)]
+    duplicates = {r: c for r, c in train_counts.items() if c > 1}
+    assert duplicates == {r: 2 for r in churned}
+    for r in killed_inflight:
+        assert train_counts[r] == 1
+
+    # Serving never dipped: live traffic rode every swap, rollback, and
+    # reload without a single dropped request.
+    assert not serve_errors
+    assert len(served) > 0
+    assert rolled_back_seen, "delta_apply fault never exercised rollback"
+    np.testing.assert_allclose(
+        np.asarray(replica.execute(feats, n_valid=16)),
+        np.asarray(trainer.eval_step(feats)),
+        rtol=1e-5,
+    )
+    assert replica.generation.step == exporter.head_step
+
+    # Freshness SLO: breached under injected faults, defended by the end.
+    assert not tracker.breached
+    assert tracker.lag_s(stream.elapsed_s) <= tracker.slo_s
+
+    # ------------------------------------------------------------------
+    # Journal: the run's whole story, schema-valid end to end.
+    # ------------------------------------------------------------------
+    events = _events(journal_file)
+    validator = _load_validator()
+    assert validator.validate_file(journal_file) == []
+
+    watermarks = [
+        e["offset"] for e in events if e["event"] == "stream_watermark"
+    ]
+    assert watermarks == sorted(watermarks)
+    assert watermarks[-1] == total
+
+    quarantined = [
+        e for e in events if e["event"] == "checkpoint_quarantined"
+    ]
+    assert any("torn write" in e["reason"] for e in quarantined)
+
+    swaps = [e for e in events if e["event"] == "model_swap"]
+    outcomes = [s["outcome"] for s in swaps]
+    assert "rolled_back" in outcomes
+    assert outcomes[-1] == "applied"
+    assert all(
+        s["undrained"] == 0 for s in swaps if s["outcome"] == "applied"
+    )
+
+    resumes = [e for e in events if e["event"] == "task_progress_resume"]
+    assert any(e.get("stream") == "clicks" for e in resumes)
+
+    slo_events = [e for e in events if e["event"] == "freshness_slo"]
+    assert [e["state"] for e in slo_events][:1] == ["breach"]
+    assert slo_events[-1]["state"] == "clear"
+
+    requeues = [
+        e for e in events
+        if e["event"] == "task_requeue"
+        and e.get("reason") == "worker_churn"
+    ]
+    assert sum(len(e["task_ids"]) for e in requeues) == len(churned)
+    assert not any(e["event"] == "request_shed" for e in events)
