@@ -21,17 +21,20 @@ native:
 test:
 	python -m pytest tests/ -q
 
-# Invariant analyzer (docs/invariants.md): the control-plane rules PLUS
-# the hot-path compute-plane family (jit-host-sync, retrace-hazard,
-# donation-discipline, trace-purity, sharding-coverage) over both the
-# package and the model zoo.  Exit 1 on any violation; suppress a
-# deliberate exception with `# noqa-invariant: <rule>`.
+# Invariant analyzer (docs/invariants.md): the control-plane rules, the
+# hot-path compute-plane family (jit-host-sync, retrace-hazard,
+# donation-discipline, trace-purity, sharding-coverage), and the
+# whole-program protocol family (drain-discipline, blocking-under-lock,
+# journal-schema — one cross-module call graph over the full scan) over
+# both the package and the model zoo.  Exit 1 on any violation;
+# suppress a deliberate exception with `# noqa-invariant: <rule>`.
 check-invariants:
 	python -m elasticdl_tpu.analysis elasticdl_tpu model_zoo
 
 # Static gate: ruff (errors-only baseline, config in pyproject.toml) when
 # available — the container may not ship it — then the invariant analyzer,
-# with its JSON findings chased by the per-rule summary table.
+# with its JSON findings chased by the per-rule summary table (findings,
+# suppressions, per-rule timing, cross-module graph size).
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check .; \

@@ -12,7 +12,14 @@ tests/test_analysis.py fixtures).
 
     {"findings": [{"rule", "path", "line", "col", "message"}, ...],
      "suppressed": N, "suppressed_by_rule": {...},
-     "files_scanned": N, "rules": [...]}
+     "files_scanned": N, "rules": [...],
+     "timing": {"program-index": s, "<rule>": s, ...},
+     "graph": {"modules": N, "edges": N, "fixpoint_iterations": N}}
+
+``timing`` is per-rule wall seconds (plus the whole-program index
+build); ``graph`` sizes the cross-module call graph the protocol rules
+reasoned over — both rendered by scripts/invariant_report.py in
+``make lint``.
 
 ``--baseline FILE`` reads a JSON allowlist (the same shape as the
 ``--format json`` output, or a bare list of findings) and drops any
@@ -188,6 +195,11 @@ def main(argv=None) -> int:
                 "suppressed_by_rule": by_rule,
                 "files_scanned": len(report.files),
                 "rules": list(args.rule or RULE_NAMES),
+                "timing": {
+                    name: round(seconds, 4)
+                    for name, seconds in report.timings.items()
+                },
+                "graph": report.graph,
             },
             indent=2,
         ))
@@ -203,6 +215,13 @@ def main(argv=None) -> int:
         )
         return 1
     print(f"check-invariants: OK ({', '.join(r for r in (args.rule or RULE_NAMES))})")
+    if report.graph:
+        print(
+            "program graph: {modules} modules, {edges} edges, "
+            "{fixpoint_iterations} fixpoint iteration(s)".format(
+                **report.graph
+            )
+        )
     return 0
 
 

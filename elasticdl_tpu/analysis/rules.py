@@ -601,6 +601,7 @@ def check_metric_label_cardinality(source: SourceFile) -> List[Violation]:
 # ---------------------------------------------------------------------------
 
 from elasticdl_tpu.analysis.jax_rules import JAX_RULES  # noqa: E402
+from elasticdl_tpu.analysis.protocol_rules import PROTOCOL_RULES  # noqa: E402
 
 ALL_RULES = {
     "rpc-deadline": check_rpc_deadline,
@@ -610,6 +611,14 @@ ALL_RULES = {
     "lock-discipline": check_lock_discipline,
     "metric-label-cardinality": check_metric_label_cardinality,
     **JAX_RULES,
+    **PROTOCOL_RULES,
 }
 
 RULE_NAMES = tuple(ALL_RULES)
+
+# Registry names double as timing keys in ScanReport.timings (core.scan
+# reads the attribute back — rules not in the registry fall back to
+# their function __name__).
+for _name, _rule in ALL_RULES.items():
+    _rule._rule_name = _name
+del _name, _rule

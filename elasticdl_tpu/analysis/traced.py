@@ -23,7 +23,11 @@ This module computes that property per file, stdlib-only:
    (``sm = _shard_map()``) are tracked per scope.
 3. **Transitive closure** — a function referenced (called or passed)
    from a traced function's body is itself traced: the helper a jitted
-   step calls runs under the same trace.
+   step calls runs under the same trace.  The closure is per-file
+   here; when the analyzer scans more than one file, the whole-program
+   index (`program.py`) resolves imports and receiver classes and
+   extends it ACROSS modules via `TracedIndex.mark_traced`, so a
+   helper imported from another package module is traced too.
 
 On top of the call graph sits a small **intraprocedural symbol pass**:
 `array_tainted_names` marks the names in a traced function that hold
@@ -345,6 +349,19 @@ class TracedIndex:
     def is_traced(self, fn) -> bool:
         info = fn if isinstance(fn, FunctionInfo) else self.by_node.get(id(fn))
         return bool(info) and info.qualname in self.traced
+
+    def mark_traced(self, qualname: str, reason: str) -> bool:
+        """Mark `qualname` traced with `reason`; True when newly marked.
+
+        The per-file walk marks same-file tracedness; the whole-program
+        index (program.py) calls this to extend the closure across
+        module boundaries — a helper that only a jitted fn in ANOTHER
+        module calls is traced too, and the per-file jax rules see it
+        because the index is shared (memoized via traced_index())."""
+        if qualname in self.traced:
+            return False
+        self.traced[qualname] = reason
+        return True
 
     def traced_infos(self) -> Iterator[FunctionInfo]:
         for qualname, info in self.functions.items():

@@ -176,75 +176,87 @@ def main(argv=None) -> int:
         on_request=book.record_request,
         on_shed=book.record_shed,
     ).start()
-    if args.warmup_features:
-        with open(args.warmup_features, "rb") as f:
-            example = decode_features(f.read())
-        replica.warmup(example, batcher.buckets)
-        logger.info("Warmed %d bucket shapes", len(batcher.buckets))
-
-    frontend = ServingFrontend(replica, batcher, port=args.port)
-    port = frontend.start()
-    exporter = MetricsExporter(port=args.metrics_port).start()
-    write_replica_info(args.serve_dir, args.replica_id, {
-        "replica_id": args.replica_id,
-        "pid": os.getpid(),
-        "port": port,
-        "metrics_port": exporter.port,
-        "model_dir": args.model_dir,
-    })
-    obs.journal().record(
-        "serving_replica_start",
-        replica_id=args.replica_id,
-        port=port,
-        model_dir=args.model_dir,
-        generation=replica.stats()["generation"],
-    )
-
-    stop = threading.Event()
-
-    def _shutdown(signum, frame):
-        logger.info("Replica %d: signal %d, shutting down", args.replica_id,
-                    signum)
-        stop.set()
-
-    signal.signal(signal.SIGTERM, _shutdown)
-    signal.signal(signal.SIGINT, _shutdown)
-
-    telemetry = threading.Thread(
-        target=_telemetry_loop,
-        args=(stop, args.telemetry_interval_s, replica, batcher,
-              args.replica_id),
-        name="serving-telemetry",
-        daemon=True,
-    )
-    telemetry.start()
-
+    # Every resource below owns a daemon thread and/or a listening
+    # socket; a failure anywhere between start() and the serve loop
+    # (warmup decode, bind error, pub_dir scan) must still drain them
+    # all, so teardown lives in one finally covering the whole lifetime.
+    frontend = None
+    exporter = None
     watcher = None
-    if args.pub_dir:
-        from elasticdl_tpu.obs.freshness import FreshnessTracker
-        from elasticdl_tpu.serving.continuous import DeltaWatcher
+    telemetry = None
+    stop = threading.Event()
+    try:
+        if args.warmup_features:
+            with open(args.warmup_features, "rb") as f:
+                example = decode_features(f.read())
+            replica.warmup(example, batcher.buckets)
+            logger.info("Warmed %d bucket shapes", len(batcher.buckets))
 
-        freshness = (
-            FreshnessTracker(args.freshness_slo_s)
-            if args.freshness_slo_s > 0
-            else None
-        )
-        watcher = DeltaWatcher(
-            replica, args.pub_dir, freshness=freshness
-        ).start(args.pub_poll_interval_s)
-        logger.info(
-            "Tracking delta chain in %s every %.1fs", args.pub_dir,
-            args.pub_poll_interval_s,
+        frontend = ServingFrontend(replica, batcher, port=args.port)
+        port = frontend.start()
+        exporter = MetricsExporter(port=args.metrics_port).start()
+        write_replica_info(args.serve_dir, args.replica_id, {
+            "replica_id": args.replica_id,
+            "pid": os.getpid(),
+            "port": port,
+            "metrics_port": exporter.port,
+            "model_dir": args.model_dir,
+        })
+        obs.journal().record(
+            "serving_replica_start",
+            replica_id=args.replica_id,
+            port=port,
+            model_dir=args.model_dir,
+            generation=replica.stats()["generation"],
         )
 
-    while not stop.wait(0.5):
-        pass
-    if watcher is not None:
-        watcher.stop()
-    frontend.stop()
-    batcher.stop()
-    exporter.stop()
-    telemetry.join(timeout=5)
+        def _shutdown(signum, frame):
+            logger.info("Replica %d: signal %d, shutting down",
+                        args.replica_id, signum)
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _shutdown)
+        signal.signal(signal.SIGINT, _shutdown)
+
+        telemetry = threading.Thread(
+            target=_telemetry_loop,
+            args=(stop, args.telemetry_interval_s, replica, batcher,
+                  args.replica_id),
+            name="serving-telemetry",
+            daemon=True,
+        )
+        telemetry.start()
+
+        if args.pub_dir:
+            from elasticdl_tpu.obs.freshness import FreshnessTracker
+            from elasticdl_tpu.serving.continuous import DeltaWatcher
+
+            freshness = (
+                FreshnessTracker(args.freshness_slo_s)
+                if args.freshness_slo_s > 0
+                else None
+            )
+            watcher = DeltaWatcher(
+                replica, args.pub_dir, freshness=freshness
+            ).start(args.pub_poll_interval_s)
+            logger.info(
+                "Tracking delta chain in %s every %.1fs", args.pub_dir,
+                args.pub_poll_interval_s,
+            )
+
+        while not stop.wait(0.5):
+            pass
+    finally:
+        stop.set()
+        if watcher is not None:
+            watcher.stop()
+        if frontend is not None:
+            frontend.stop()
+        batcher.stop()
+        if exporter is not None:
+            exporter.stop()
+        if telemetry is not None:
+            telemetry.join(timeout=5)
     return 0
 
 

@@ -44,6 +44,24 @@ def render(data: dict) -> str:
         f"{data.get('suppressed', 0):>12}"
         f"   ({data.get('files_scanned', 0)} files scanned)"
     )
+    # Cost visibility (make lint): where the analyzer's wall time goes,
+    # rule family by rule family, plus the size of the cross-module
+    # graph the whole-program rules reasoned over.
+    timing = data.get("timing", {})
+    if timing:
+        total_s = sum(timing.values())
+        slowest = sorted(timing.items(), key=lambda kv: -kv[1])
+        lines.append(
+            "timing: "
+            + ", ".join(f"{name} {seconds:.2f}s" for name, seconds in slowest)
+            + f"   (total {total_s:.2f}s)"
+        )
+    graph = data.get("graph", {})
+    if graph:
+        lines.append(
+            "program graph: {modules} modules, {edges} edges, "
+            "{fixpoint_iterations} fixpoint iteration(s)".format(**graph)
+        )
     # The counts alone don't locate anything: repeat each finding in the
     # analyzer's text format so `make lint` output stays actionable.
     if findings:

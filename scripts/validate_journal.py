@@ -122,9 +122,10 @@ EVENT_REQUIRED_FIELDS = {
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
 #: open for extension (unknown events in a file pass — an old validator
 #: must not reject a newer master's journal), but the repo's own call
-#: sites must register here: ``--check-sources`` greps the source tree
-#: for journal emissions and fails on any name missing from this set,
-#: so schema drift can't recur silently.
+#: sites must register here: ``--check-sources`` runs the analyzer's
+#: AST ``journal-schema`` rule over the source tree and fails on any
+#: emission whose event name is missing from this set, so schema drift
+#: can't recur silently.
 KNOWN_EVENTS = frozenset(EVENT_REQUIRED_FIELDS) | {
     "task_progress_resume",
     "train_epoch_done",
@@ -135,6 +136,105 @@ KNOWN_EVENTS = frozenset(EVENT_REQUIRED_FIELDS) | {
     "checkpoint_restored",
     "checkpoint_quarantined",
 }
+
+#: Optional fields per event: everything a call site may carry BESIDE
+#: the required fields and the ts/event envelope.  This is the
+#: field-level half of the source contract — the analyzer's
+#: ``journal-schema`` rule flags any literal kwarg/dict key at an
+#: emission site that is in neither the required nor the optional set,
+#: which is how a misspelled field (``generaton=...``) gets caught at
+#: lint time instead of at post-mortem grep time.  Journal-FILE
+#: validation stays permissive (extra fields in a file always pass).
+#: Every KNOWN_EVENTS entry appears here, even when empty, so adding a
+#: field is an explicit one-line registration.
+EVENT_OPTIONAL_FIELDS = {
+    "master_start": ("port", "metrics_port"),
+    "rendezvous": ("coordinator", "workers"),
+    "task_dispatch": ("type", "shard", "start", "end", "epoch"),
+    "task_done": ("worker_id", "type", "duration_s"),
+    "task_requeue": (
+        "task_id", "task_ids", "worker_id", "trace_id", "trace_ids",
+        "retry", "records", "timeout_s",
+    ),
+    "task_failed_permanently": (
+        "trace_id", "retries", "shard", "start", "end",
+    ),
+    "task_progress_resume": (
+        "stream", "epoch", "todo", "finished_records", "next_offset",
+        "watermark", "completed_above_watermark",
+    ),
+    "train_epoch_done": ("epoch", "next_epoch"),
+    "job_complete": ("restarts_used",),
+    "job_failed": (),
+    "worker_churn": ("old_size", "restarts_used", "budget_left"),
+    "hung_worker_kill": ("silent_s",),
+    "worker_telemetry": (
+        "worker_ts", "step", "step_p50_s", "step_p95_s", "examples_s",
+        "data_wait_s", "host",
+    ),
+    "straggler_detected": ("value", "threshold", "median"),
+    "straggler_cleared": ("metric",),
+    "scale": ("direction",),
+    "scale_up": ("direction",),
+    "pod_create_failed": ("pod", "error"),
+    "pod_pending_timeout": ("pod", "timeout_s"),
+    "span": (
+        "trace_id", "span_id", "parent_span_id", "start_ts", "proc",
+        "task_id", "worker_id", "error", "steps",
+    ),
+    "phase_transition": ("cause",),
+    "rescale_cost": (
+        "seq", "old_size", "new_size", "rendezvous_id", "redo_tasks",
+        "redo_records", "superseded",
+    ),
+    "goodput_summary": (
+        "outcome", "rescales", "records_done", "records_redone",
+    ),
+    "policy_decision": (
+        "worker_id", "flag_streak_ticks", "kill_budget_remaining",
+        "evidence", "old_size", "new_size",
+    ),
+    "step_anatomy": (
+        "totals", "fractions", "steps", "examples", "retraces", "bound",
+        "dominant_phase", "overlap_s",
+    ),
+    "profile_window": ("step_start", "step_end"),
+    "bench_regress": ("details", "baseline"),
+    "sparse_kernel_selected": (
+        "requested", "route", "optimizer", "tables", "table_rows",
+    ),
+    "compile_plan": (
+        "name", "rule_table", "rule_hits", "rule_misses",
+        "donated_argnums", "devices",
+    ),
+    "clock_probe": ("rtt_s",),
+    "registry_snapshot": ("proc", "metrics"),
+    "model_swap": (
+        "old_generation", "old_step", "model_dir", "drained_inflight",
+        "undrained", "kind", "outcome", "reason", "event_time",
+    ),
+    "request_shed": (
+        "queue_depth", "queue_limit", "rows", "waited_s",
+    ),
+    "serving_telemetry": (
+        "generation", "step", "inflight", "queue_depth", "qps",
+        "p50_ms", "p99_ms", "availability_ratio", "served", "dropped",
+        "shed", "errors", "model_event_time",
+    ),
+    "serving_replica_start": ("model_dir", "generation"),
+    "serving_fleet_start": ("model_dir", "serve_dir"),
+    "stream_watermark": ("event_time", "next_offset", "pending_ranges"),
+    "delta_checkpoint": ("rows", "tables", "event_time"),
+    "delta_compaction": ("deltas_folded", "event_time"),
+    "freshness_slo": ("stage", "generation", "step"),
+    "checkpoint_saved": ("step", "kind", "n_processes", "event_time"),
+    "checkpoint_restored": ("step", "kind"),
+    "checkpoint_quarantined": ("path", "reason"),
+}
+assert set(EVENT_OPTIONAL_FIELDS) == set(KNOWN_EVENTS), (
+    "EVENT_OPTIONAL_FIELDS must carry an entry (possibly empty) for "
+    "every known event"
+)
 
 
 def validate_record(record: object) -> List[str]:
@@ -173,52 +273,54 @@ def validate_file(path: str) -> List[Tuple[int, str]]:
     return problems
 
 
-#: Emission sites: a literal first argument to ``journal.record(...)``
-#: (possibly via ``obs.journal().record(...)``), or an ``event="..."``
-#: kwarg inside a record dict later splatted into ``record(**event)``.
-_RECORD_CALL_RE = re.compile(
-    r"\.record\(\s*[\"']([A-Za-z_][A-Za-z0-9_]*)[\"']"
-)
-_EVENT_KWARG_RE = re.compile(
-    r"\bevent\s*=\s*[\"']([A-Za-z_][A-Za-z0-9_]*)[\"']"
-)
+#: ``--check-sources`` is an alias for the analyzer's AST
+#: ``journal-schema`` rule (elasticdl_tpu/analysis/protocol_rules.py).
+#: The old regex scanner matched event NAMES only; the AST rule also
+#: checks every literal field at each ``journal.record(...)`` /
+#: ``record_span(...)`` / ``dict(event=...)`` site against
+#: EVENT_REQUIRED_FIELDS / EVENT_OPTIONAL_FIELDS above, so a misspelled
+#: field now fails the gate where the grep passed it.
+_UNKNOWN_EVENT_RE = re.compile(r"unknown journal event '([^']+)'")
+
+
+def _analysis_scan(root: str):
+    """One journal-schema pass of the analyzer over `root`."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from elasticdl_tpu.analysis.core import scan
+    from elasticdl_tpu.analysis.protocol_rules import check_journal_schema
+
+    return scan([root], [check_journal_schema])
 
 
 def scan_sources(root: str) -> List[Tuple[str, int, str]]:
     """(path, line, event) for every journal emission whose event type is
     not registered in KNOWN_EVENTS.  Scans the package source tree —
     tests journal arbitrary demo events and are deliberately excluded."""
-    unknown, _scanned = scan_sources_counted(root)
+    unknown: List[Tuple[str, int, str]] = []
+    for violation in _analysis_scan(root).violations:
+        match = _UNKNOWN_EVENT_RE.search(violation.message)
+        if match:
+            unknown.append((violation.path, violation.line, match.group(1)))
     return unknown
 
 
 def scan_sources_counted(root: str) -> Tuple[List[Tuple[str, int, str]], int]:
-    unknown: List[Tuple[str, int, str]] = []
-    scanned = 0
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for filename in filenames:
-            if not filename.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, filename)
-            try:
-                with open(path, "r", encoding="utf-8") as f:
-                    text = f.read()
-            except (OSError, UnicodeDecodeError):
-                continue
-            scanned += 1
-            for regex in (_RECORD_CALL_RE, _EVENT_KWARG_RE):
-                for match in regex.finditer(text):
-                    event = match.group(1)
-                    if event not in KNOWN_EVENTS:
-                        line = text.count("\n", 0, match.start()) + 1
-                        unknown.append((path, line, event))
-    return unknown, scanned
+    """All journal-schema findings as (path, line, message), plus the
+    scanned-file count (zero means the gate looked at nothing)."""
+    report = _analysis_scan(root)
+    problems = [
+        (violation.path, violation.line, violation.message)
+        for violation in report.violations
+    ]
+    return problems, len(report.files)
 
 
 def _check_sources(root: str) -> int:
-    unknown, scanned = scan_sources_counted(root)
-    if scanned == 0:
+    if not os.path.isdir(root) and not (
+        os.path.isfile(root) and root.endswith(".py")
+    ):
         # A gate that scanned nothing must not pass (same rule as the
         # analysis CLI's zero-file-scan exit): a wrong cwd or a moved
         # tree would otherwise silently disable drift detection.
@@ -227,17 +329,25 @@ def _check_sources(root: str) -> int:
             "directory? (run from the repo root)", file=sys.stderr,
         )
         return 2
-    if unknown:
+    problems, scanned = scan_sources_counted(root)
+    if scanned == 0:
         print(
-            "journal schema drift: event types emitted but not registered "
-            "in scripts/validate_journal.py KNOWN_EVENTS:", file=sys.stderr,
+            f"check-sources: no .py files under {root!r} — wrong "
+            "directory? (run from the repo root)", file=sys.stderr,
         )
-        for path, line, event in sorted(unknown):
-            print(f"  {path}:{line}: {event!r}", file=sys.stderr)
+        return 2
+    if problems:
+        print(
+            "journal schema drift (event names and fields are checked "
+            "against scripts/validate_journal.py registries by the "
+            "analyzer's journal-schema rule):", file=sys.stderr,
+        )
+        for path, line, message in sorted(problems):
+            print(f"  {path}:{line}: {message}", file=sys.stderr)
         return 1
     print(
-        f"check-sources OK ({root}: {scanned} files, all emitted event "
-        "types registered)"
+        f"check-sources OK ({root}: {scanned} files, every emission "
+        "site matches the registered event + field schema)"
     )
     return 0
 
@@ -408,8 +518,9 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--check-sources", nargs="?", const="elasticdl_tpu",
         default=None, metavar="DIR",
-        help="scan the source tree (default: elasticdl_tpu) for journal "
-        "emissions with unregistered event types and fail on drift",
+        help="run the analyzer's AST journal-schema rule over the source "
+        "tree (default: elasticdl_tpu) and fail on unregistered event "
+        "types or unregistered/missing fields",
     )
     args = parser.parse_args(argv)
     if args.check_sources is not None:

@@ -1011,6 +1011,32 @@ _SEEDED_VIOLATIONS = {
         "import jax\n"
         "step = jax.jit(lambda x: x + 1)\n"
     ),
+    "drain-discipline": (
+        "class Prefetcher:\n"
+        "    def close(self):\n"
+        "        pass\n"
+        "def consume(it):\n"
+        "    p = Prefetcher()\n"
+        "    for _ in it:\n"
+        "        pass\n"
+    ),
+    "blocking-under-lock": (
+        "import threading\n"
+        "import time\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._x = 0  # guarded-by: _lock\n"
+        "    def poke(self):\n"
+        "        with self._lock:\n"
+        "            self._helper()\n"
+        "    def _helper(self):\n"
+        "        time.sleep(1)\n"
+    ),
+    "journal-schema": (
+        "def f(journal):\n"
+        "    journal.record('model_swap', generaton=2, step=4096)\n"
+    ),
 }
 
 
@@ -1232,3 +1258,582 @@ def test_cli_baseline_basename_entry_does_not_allowlist_other_dirs(tmp_path):
     assert rc == 1 and len(data["findings"]) == 1
     assert data["findings"][0]["path"].endswith("b/trainer.py")
     assert data["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# drain-discipline (protocol_rules.py): constructed resources reach
+# teardown on every path
+# ---------------------------------------------------------------------------
+
+_PREFETCHER = """
+class Prefetcher:
+    def __init__(self):
+        self._threads = []
+
+    def close(self):
+        pass
+
+"""
+
+
+def test_drain_discipline_flags_never_drained_resource():
+    found = violations(
+        _PREFETCHER
+        + """
+def consume(it):
+    p = Prefetcher()
+    for _ in it:
+        pass
+""",
+        "drain-discipline",
+    )
+    assert len(found) == 1
+    assert "close" in found[0].message
+    assert "never reaches" in found[0].message
+
+
+def test_drain_discipline_flags_straight_line_only_teardown():
+    """close() after the loop body leaks on ANY exception in the loop —
+    the replica_main.py bug class this rule exists for."""
+    found = violations(
+        _PREFETCHER
+        + """
+def consume(it):
+    p = Prefetcher()
+    for _ in it:
+        pass
+    p.close()
+""",
+        "drain-discipline",
+    )
+    assert len(found) == 1
+    assert "straight-line" in found[0].message
+
+
+def test_drain_discipline_accepts_try_finally():
+    assert (
+        violations(
+            _PREFETCHER
+            + """
+def consume(it):
+    p = Prefetcher()
+    try:
+        for _ in it:
+            pass
+    finally:
+        p.close()
+""",
+            "drain-discipline",
+        )
+        == []
+    )
+
+
+def test_drain_discipline_accepts_with_use():
+    assert (
+        violations(
+            _PREFETCHER
+            + """
+def consume(it):
+    p = Prefetcher()
+    with p:
+        pass
+""",
+            "drain-discipline",
+        )
+        == []
+    )
+
+
+def test_drain_discipline_accepts_ownership_transfer():
+    """Returning / handing off the resource transfers the teardown
+    obligation — the task_data_service.get_batches() shape."""
+    assert (
+        violations(
+            _PREFETCHER
+            + """
+def make():
+    p = Prefetcher()
+    return p
+
+def hand(registry):
+    p = Prefetcher()
+    registry.adopt(p)
+""",
+            "drain-discipline",
+        )
+        == []
+    )
+
+
+def test_drain_discipline_builder_chain_and_receiver_use():
+    """`Cls(...).start()` still resolves to the constructed class, and
+    calling methods / reading attrs on the tracked name is NOT an
+    ownership transfer (the replica_main.py `port = frontend.start()`
+    false-negative shape)."""
+    found = violations(
+        _PREFETCHER
+        + """
+def serve(it):
+    p = Prefetcher().start()
+    port = p.port
+    for _ in it:
+        pass
+    p.close()
+""",
+        "drain-discipline",
+    )
+    assert len(found) == 1 and "straight-line" in found[0].message
+
+
+def test_drain_discipline_field_store_needs_owner_teardown():
+    found = violations(
+        _PREFETCHER
+        + """
+class Holder:
+    def __init__(self):
+        self._p = Prefetcher()
+""",
+        "drain-discipline",
+    )
+    assert len(found) == 1
+    clean = violations(
+        _PREFETCHER
+        + """
+class Holder:
+    def __init__(self):
+        self._p = Prefetcher()
+
+    def close(self):
+        self._p.close()
+""",
+        "drain-discipline",
+    )
+    assert clean == []
+
+
+def test_drain_discipline_suppression():
+    assert (
+        violations(
+            _PREFETCHER
+            + """
+def consume(it):
+    p = Prefetcher()  # noqa-invariant: drain-discipline
+    for _ in it:
+        pass
+""",
+            "drain-discipline",
+        )
+        == []
+    )
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (protocol_rules.py): no RPC / sleep / file I/O /
+# joins reachable while holding a guarded-by lock
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS_HEAD = """
+import threading
+import time
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0  # guarded-by: _lock
+"""
+
+
+def test_blocking_under_lock_flags_direct_sleep():
+    found = violations(
+        _LOCKED_CLASS_HEAD
+        + """
+    def tick(self):
+        with self._lock:
+            self._state += 1
+            time.sleep(0.5)
+""",
+        "blocking-under-lock",
+    )
+    assert len(found) == 1
+    assert "time.sleep" in found[0].message
+    assert "Service._lock" in found[0].message
+
+
+def test_blocking_under_lock_flags_transitive_same_file():
+    """The sleep is one call below the critical section — reachability,
+    not syntax, is what the rule checks."""
+    found = violations(
+        _LOCKED_CLASS_HEAD
+        + """
+    def tick(self):
+        with self._lock:
+            self._helper()
+
+    def _helper(self):
+        time.sleep(0.5)
+""",
+        "blocking-under-lock",
+    )
+    assert len(found) == 1
+    assert "time.sleep" in found[0].message
+
+
+def test_blocking_under_lock_accepts_work_outside_critical_section():
+    assert (
+        violations(
+            _LOCKED_CLASS_HEAD
+            + """
+    def tick(self):
+        with self._lock:
+            self._state += 1
+        time.sleep(0.5)
+        self._helper()
+
+    def _helper(self):
+        time.sleep(0.5)
+""",
+            "blocking-under-lock",
+        )
+        == []
+    )
+
+
+def test_blocking_under_lock_flags_locked_suffix_method():
+    """`*_locked` methods run under their class's lock by contract."""
+    found = violations(
+        _LOCKED_CLASS_HEAD
+        + """
+    def _flush_locked(self):
+        with open("/tmp/x", "w") as f:
+            f.write("x")
+""",
+        "blocking-under-lock",
+    )
+    assert len(found) == 1
+    assert "file I/O" in found[0].message
+
+
+def test_blocking_under_lock_suppression():
+    assert (
+        violations(
+            _LOCKED_CLASS_HEAD
+            + """
+    def tick(self):
+        with self._lock:
+            time.sleep(0.5)  # noqa-invariant: blocking-under-lock
+""",
+            "blocking-under-lock",
+        )
+        == []
+    )
+
+
+def test_blocking_under_lock_cross_module_chain(tmp_path):
+    """THE whole-program acceptance fixture: the lock is in one module,
+    the sleep two calls below it in another — only cross-module call
+    resolution can connect them."""
+    (tmp_path / "helpers.py").write_text(
+        "import time\n"
+        "\n"
+        "def deep():\n"
+        "    time.sleep(0.5)\n"
+        "\n"
+        "def poll():\n"
+        "    deep()\n"
+    )
+    (tmp_path / "svc.py").write_text(
+        "import threading\n"
+        "\n"
+        "import helpers\n"
+        "\n"
+        "class Service:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._state = 0  # guarded-by: _lock\n"
+        "\n"
+        "    def tick(self):\n"
+        "        with self._lock:\n"
+        "            helpers.poll()\n"
+    )
+    found = run_checks([str(tmp_path)], [ALL_RULES["blocking-under-lock"]])
+    assert len(found) == 1
+    assert found[0].path.endswith("svc.py")
+    assert "time.sleep" in found[0].message
+    assert "via" in found[0].message  # the call chain is named
+
+
+def test_cross_module_tracedness_reaches_jax_rules(tmp_path):
+    """Tracedness propagates over imports: a helper that only a jitted
+    fn in ANOTHER module calls is traced, so its host sync is flagged."""
+    (tmp_path / "lib.py").write_text(
+        "def helper(x):\n"
+        "    print(x)\n"
+        "    return x\n"
+    )
+    (tmp_path / "step.py").write_text(
+        "import jax\n"
+        "\n"
+        "from lib import helper\n"
+        "\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return helper(x)\n"
+    )
+    found = run_checks([str(tmp_path)], [ALL_RULES["jit-host-sync"]])
+    assert any(
+        v.path.endswith("lib.py") and "print" in v.message for v in found
+    )
+
+
+# ---------------------------------------------------------------------------
+# journal-schema (protocol_rules.py): emission sites match the
+# validate_journal.py registry field-for-field
+# ---------------------------------------------------------------------------
+
+
+def test_journal_schema_flags_misspelled_field():
+    found = violations(
+        """
+        def f(journal):
+            journal.record("model_swap", generaton=2, step=4096)
+        """,
+        "journal-schema",
+    )
+    assert any("generaton" in v.message for v in found)
+    assert any("missing required" in v.message for v in found)
+
+
+def test_journal_schema_flags_unknown_event():
+    found = violations(
+        """
+        def f(journal):
+            journal.record("totally_unknown_event", a=1)
+        """,
+        "journal-schema",
+    )
+    assert len(found) == 1 and "unknown journal event" in found[0].message
+
+
+def test_journal_schema_flags_missing_required_field():
+    found = violations(
+        """
+        def f(journal):
+            journal.record("rendezvous", rendezvous_id=1)
+        """,
+        "journal-schema",
+    )
+    assert len(found) == 1
+    assert "world_size" in found[0].message
+
+
+def test_journal_schema_flags_nonliteral_event_name():
+    found = violations(
+        """
+        def f(journal, name):
+            journal.record(name, a=1)
+        """,
+        "journal-schema",
+    )
+    assert len(found) == 1 and "non-literal" in found[0].message
+
+
+def test_journal_schema_accepts_registered_site():
+    assert (
+        violations(
+            """
+            def f(journal):
+                journal.record(
+                    "model_swap", generation=2, step=4096,
+                    old_generation=1, outcome="committed",
+                )
+            """,
+            "journal-schema",
+        )
+        == []
+    )
+
+
+def test_journal_schema_checks_dict_event_payloads():
+    """`record(**payload)` is invisible at the call — the gate moves to
+    the dict(event=...) / {"event": ...} build site."""
+    found = violations(
+        """
+        def f():
+            return dict(event="task_dispatch", task_id=1, worker_id=0)
+        """,
+        "journal-schema",
+    )
+    assert len(found) == 1 and "trace_id" in found[0].message
+    found = violations(
+        """
+        def f():
+            return {"event": "stream_watermark", "stream": "s",
+                    "offset": 1, "pending_rangez": 2}
+        """,
+        "journal-schema",
+    )
+    assert len(found) == 1 and "pending_rangez" in found[0].message
+
+
+def test_journal_schema_record_span_checks_extras_only():
+    assert (
+        violations(
+            """
+            def f(tracer):
+                tracer.record_span("step.execute", duration_s=1.0,
+                                   task_id=3, worker_id=1)
+            """,
+            "journal-schema",
+        )
+        == []
+    )
+    found = violations(
+        """
+        def f(tracer):
+            tracer.record_span("step.execute", duration_s=1.0,
+                               tsak_id=3)
+        """,
+        "journal-schema",
+    )
+    assert len(found) == 1 and "tsak_id" in found[0].message
+
+
+def test_journal_schema_suppression():
+    assert (
+        violations(
+            """
+            def f(journal):
+                journal.record("demo_event", a=1)  # noqa-invariant: journal-schema
+            """,
+            "journal-schema",
+        )
+        == []
+    )
+
+
+def test_check_sources_routes_through_ast_rule(tmp_path):
+    """Regression pin for the --check-sources upgrade: a misspelled
+    FIELD on a known event fails the gate now; the retired name-only
+    grep passed it (the event name is registered)."""
+    import importlib.util
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "validate_journal_for_analysis_test",
+        os.path.join(repo_root, "scripts", "validate_journal.py"),
+    )
+    validator = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(validator)
+
+    drifting = tmp_path / "drifting.py"
+    drifting.write_text(
+        'journal.record("model_swap", generaton=2, step=4096)\n'
+    )
+    assert "model_swap" in validator.KNOWN_EVENTS  # grep saw no drift…
+    assert validator.scan_sources(str(tmp_path)) == []  # …and still doesn't
+    assert validator._check_sources(str(tmp_path)) == 1  # the AST rule does
+    problems, scanned = validator.scan_sources_counted(str(tmp_path))
+    assert scanned == 1
+    assert any("generaton" in message for _p, _l, message in problems)
+
+
+def test_journal_optional_registry_covers_every_known_event():
+    """The field contract only bites when every event has an (even
+    empty) optional entry — a gap would silently disable extras
+    checking for that event."""
+    import importlib.util
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "validate_journal_for_registry_test",
+        os.path.join(repo_root, "scripts", "validate_journal.py"),
+    )
+    validator = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(validator)
+    assert set(validator.EVENT_OPTIONAL_FIELDS) == set(validator.KNOWN_EVENTS)
+
+
+# ---------------------------------------------------------------------------
+# Whole-program index: CLI stats, timing plumbing, runtime budget
+# ---------------------------------------------------------------------------
+
+
+def test_cli_reports_program_graph_stats(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert analysis_main([str(clean)]) == 0
+    out = capsys.readouterr().out
+    assert "program graph:" in out
+    assert "fixpoint iteration" in out
+
+
+def test_cli_json_includes_timing_and_graph(tmp_path, capsys):
+    import json
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert analysis_main([str(clean), "--format", "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert "program-index" in data["timing"]
+    for rule in RULE_NAMES:
+        assert rule in data["timing"]
+    assert data["graph"]["modules"] == 1
+    assert data["graph"]["fixpoint_iterations"] >= 1
+
+
+def test_invariant_report_renders_timing_and_graph():
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        import invariant_report
+    finally:
+        sys.path.pop(0)
+
+    rendered = invariant_report.render(
+        {
+            "findings": [],
+            "suppressed": 0,
+            "suppressed_by_rule": {},
+            "files_scanned": 3,
+            "rules": ["drain-discipline"],
+            "timing": {"program-index": 0.5, "drain-discipline": 0.25},
+            "graph": {"modules": 3, "edges": 11, "fixpoint_iterations": 2},
+        }
+    )
+    assert "timing:" in rendered
+    assert "program-index 0.50s" in rendered
+    assert "total 0.75s" in rendered
+    assert "program graph: 3 modules, 11 edges, 2 fixpoint iteration(s)" in rendered
+
+
+def test_serving_and_data_trees_are_invariant_clean():
+    """The sweep that motivated this analyzer: the serving and data
+    planes (where the drained-on-every-path bugs lived) gate clean."""
+    import os
+
+    import elasticdl_tpu
+
+    pkg = os.path.dirname(os.path.abspath(elasticdl_tpu.__file__))
+    assert analysis_main(
+        [os.path.join(pkg, "serving"), os.path.join(pkg, "data")]
+    ) == 0
+
+
+def test_analyzer_full_sweep_stays_under_budget():
+    """The whole-program pass (index + 15 rules over the full package)
+    must stay cheap enough for `make lint` / pre-commit use."""
+    import time
+
+    from elasticdl_tpu.analysis.__main__ import default_paths
+    from elasticdl_tpu.analysis.core import scan
+
+    start = time.perf_counter()
+    report = scan(default_paths(), ALL_RULES.values())
+    elapsed = time.perf_counter() - start
+    assert report.files, "budget test scanned nothing"
+    assert elapsed < 60.0, f"analyzer sweep took {elapsed:.1f}s"

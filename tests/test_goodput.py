@@ -402,6 +402,18 @@ def test_journal_source_scan_repo_clean_and_detects_drift(tmp_path):
         event for _p, _l, event in validator.scan_sources(str(tmp_path))
     }
     assert unknown == {"totally_new_event", "another_unregistered"}
+    # Field-level drift: the event name is registered, the field is
+    # misspelled — the AST-backed gate catches what the retired
+    # name-only grep passed.
+    drifting.write_text(
+        'obs.journal().record("rendezvous", rendezvous_id=1,\n'
+        '                     world_size=2, coordinater=0)\n'
+    )
+    assert validator.scan_sources(str(tmp_path)) == []  # name is known
+    problems, scanned = validator.scan_sources_counted(str(tmp_path))
+    assert scanned == 1
+    assert any("coordinater" in message for _p, _l, message in problems)
+    assert validator._check_sources(str(tmp_path)) == 1
     # A scan that matched zero files must FAIL, not pass vacuously
     # (wrong cwd would otherwise silently disable the drift gate).
     empty = tmp_path / "empty"
