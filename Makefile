@@ -5,8 +5,9 @@
 
 .PHONY: all proto native test test-fast test-sparse sparse-gates \
         test-compile compile-gates test-chaos test-obs test-serving \
-        serving-gates test-pipeline test-stream stream-gates e2e bench \
-        bench-regress wheel clean lint check-invariants
+        serving-gates test-pipeline test-stream stream-gates test-slo \
+        slo-gates e2e bench bench-regress wheel clean lint \
+        check-invariants
 
 all: proto native test
 
@@ -60,7 +61,8 @@ lint:
 # test-fast's own `pytest tests/` sweep, so chaining the full
 # test-sparse / test-compile targets would run them twice per tier-1
 # pass.
-test-fast: lint sparse-gates compile-gates serving-gates stream-gates
+test-fast: lint sparse-gates compile-gates serving-gates stream-gates \
+           slo-gates
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
 # Script gate of the continuous train->serve loop, shared by
@@ -68,6 +70,22 @@ test-fast: lint sparse-gates compile-gates serving-gates stream-gates
 # breach/clear transition selftest (one journal event per transition).
 stream-gates:
 	JAX_PLATFORMS=cpu python -m elasticdl_tpu.obs.freshness --selftest
+
+# Script gate of the SLO plane, shared by test-slo and test-fast: the
+# burn-rate alerting selftest — a deterministic virtual-clock run with
+# an injected latency regression must page within the fast window,
+# clear after it, journal schema-shaped slo_status/slo_alert events,
+# and fire nothing on the no-fault control run.
+slo-gates:
+	JAX_PLATFORMS=cpu python -m elasticdl_tpu.obs.slo --selftest
+
+# Standalone SLO-plane gate (docs/observability.md "SLO plane"): the
+# metrics-history ring (eviction boundedness, clock-regression clamp,
+# window queries), burn-rate math + fire/clear edges, the policy
+# advisory wiring, the /slo endpoint, and — without `-m 'not slow'` —
+# the 2-replica serving-fleet alerting acceptance e2e.
+test-slo: slo-gates
+	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q
 
 # Standalone continuous-loop gate (docs/design.md "Continuous
 # training"): the streaming dispatcher (watermark eviction, bounded
@@ -150,12 +168,15 @@ test-sparse: sparse-gates
 # the goodput ledger/report plane, and the distributed tracing plane
 # (span trees, clock alignment, Perfetto export — tests/test_tracing.py
 # + the obs.trace selftest) — then the journal schema validator's
-# selftest + source-drift check and the postmortem report's selftest
-# over the golden journal fixture.
-test-obs:
+# selftest + source-drift check, the postmortem report's selftest
+# over the golden journal fixture, and the SLO plane (history ring +
+# burn-rate alerting; test_slo.py's fleet e2e is `slow`-marked here —
+# `make test-slo` runs it).
+test-obs: slo-gates
 	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
 	       tests/test_telemetry.py tests/test_goodput.py \
 	       tests/test_stepstats.py tests/test_tracing.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q -m 'not slow'
 	python scripts/validate_journal.py --selftest --check-sources
 	python scripts/validate_journal.py tests/golden_journal.jsonl
 	python -m elasticdl_tpu.obs.trace --selftest

@@ -305,6 +305,28 @@ def add_cluster_arguments(parser: argparse.ArgumentParser):
         "refills when a window elapses.",
     )
     parser.add_argument(
+        "--slo_enabled", type=str2bool, nargs="?", const=True, default=True,
+        help="Run the master's SLO plane (obs/slo.py): a metrics-history "
+        "sampler + burn-rate evaluator feeding /slo and the policy "
+        "engine's advisory input.",
+    )
+    parser.add_argument(
+        "--slo_goodput_target", type=float, default=0.0,
+        help="Goodput-ratio floor for the master goodput SLO; 0 "
+        "registers no goodput SLO (the history sampler still runs for "
+        "/slo sparklines).",
+    )
+    parser.add_argument(
+        "--slo_compliance_window_s", type=float, default=3600.0,
+        help="Rolling error-budget compliance window; the burn-rate "
+        "alert windows are the canonical 30-day fractions of this "
+        "(docs/observability.md 'SLO plane').",
+    )
+    parser.add_argument(
+        "--slo_tick_interval_s", type=float, default=2.0,
+        help="Seconds between SLO-plane sample+evaluate ticks.",
+    )
+    parser.add_argument(
         "--worker_liveness_timeout_s", type=non_neg_int, default=60,
         help="Kill+relaunch a worker whose heartbeat is silent this long "
         "(0 disables hung-worker detection)",

@@ -102,6 +102,37 @@ def _build_policy_engine(args, master):
     )
 
 
+def _build_slo_plane(args, master, policy_engine):
+    """The master's SLO plane (obs/slo.py): a metrics-history sampler +
+    burn-rate evaluator over the process registry.  The goodput SLO is
+    registered only when --slo_goodput_target > 0; the sampler itself
+    always runs (it feeds /slo sparklines and costs one registry scrape
+    per tick).  Alert edges flow to the policy engine as advisories."""
+    if not getattr(args, "slo_enabled", True):
+        return None
+    from elasticdl_tpu.obs.slo import SLOPlane, goodput_slo
+
+    specs = []
+    target = float(getattr(args, "slo_goodput_target", 0.0) or 0.0)
+    if target > 0:
+        specs.append(goodput_slo(
+            target,
+            compliance_window_s=float(
+                getattr(args, "slo_compliance_window_s", 3600.0)
+            ),
+        ))
+    plane = SLOPlane(
+        specs=specs,
+        tick_interval_s=float(getattr(args, "slo_tick_interval_s", 2.0)),
+        origin="master",
+    )
+    if policy_engine is not None:
+        plane.slos.add_alert_callback(policy_engine.note_slo_alert)
+    if master.metrics_exporter is not None:
+        master.metrics_exporter.set_slo_plane(plane)
+    return plane
+
+
 class _GatedScaleUp:
     """Chain policy and capacity: the policy says whether a rescale
     would pay (amortization, cooldown, thrash — every denial journals a
@@ -300,12 +331,15 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
         master.tensorboard_service.bind(
             restarts_fn=lambda: manager.restarts_used
         )
+    slo_plane = _build_slo_plane(args, master, policy_engine)
     progress_persister = master.progress_persister
     job_succeeded = False
     try:
         manager.start()
         if policy_engine is not None:
             policy_engine.start()
+        if slo_plane is not None:
+            slo_plane.start()
         ok = manager.wait()
         if master.evaluation_service is not None:
             master.evaluation_service.finalize()
@@ -322,6 +356,8 @@ def run_allreduce_job(args, mode: str = Mode.TRAINING) -> int:
         job_succeeded = True
         return 0
     finally:
+        if slo_plane is not None:
+            slo_plane.stop()
         if policy_engine is not None:
             policy_engine.stop()
         manager.stop()

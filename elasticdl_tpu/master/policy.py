@@ -161,6 +161,12 @@ class ElasticPolicyEngine:
         # and DISTINCT workers' eviction-fallback holds are distinct
         # evidence, never deduped against each other.
         self._last_hold: Dict[tuple, float] = {}  # guarded-by: _lock
+        # slo name -> fire evidence from the SLO plane (obs/slo.py) —
+        # advisory only: it rides every journaled decision as
+        # `slo_advisory` so the audit trail shows what the sensors said
+        # while the engine acted.  Full SLO-driven serving autoscale is
+        # ROADMAP item 2; this is its input edge.
+        self._slo_alerts: Dict[str, dict] = {}  # guarded-by: _lock
         self._last_decision: Optional[dict] = None  # guarded-by: _lock
         self._last_scale_action_t = float("-inf")  # guarded-by: _lock
         self._pre_approval_scale_t = float("-inf")  # guarded-by: _lock
@@ -253,6 +259,34 @@ class ElasticPolicyEngine:
                 self._flagged.pop(worker_id, None)
                 self._flag_streak.pop(worker_id, None)
                 self._prune_holds_locked(self._flagged)
+
+    def note_slo_alert(self, slo: str, alerting: bool, evidence=None):
+        """SLO-plane input (`SLORegistry.add_alert_callback` on the
+        master, `SLOAlertFollower` on the serving supervisor): track the
+        fired set and journal the edge as an advisory hold.  A clear for
+        an SLO that never fired here is dropped — a follower replaying
+        an old journal tail must not emit phantom clears."""
+        now = self._clock()
+        slo = str(slo)
+        evidence = dict(evidence or {})
+        with self._lock:
+            if alerting:
+                self._slo_alerts[slo] = evidence
+            elif self._slo_alerts.pop(slo, None) is None:
+                return
+        self._hold(
+            now,
+            "slo_alert" if alerting else "slo_alert_cleared",
+            slo=slo,
+            **{k: evidence[k] for k in
+               ("grade", "burn_rates", "budget_remaining_ratio",
+                "offending", "origin") if k in evidence},
+        )
+
+    def slo_alerts(self) -> Dict[str, dict]:
+        """Currently-fired SLO alerts: name -> fire evidence."""
+        with self._lock:
+            return {name: dict(ev) for name, ev in self._slo_alerts.items()}
 
     def _prune_holds_locked(self, flagged) -> None:
         """Drop per-worker hold-dedup entries for workers no longer
@@ -656,6 +690,10 @@ class ElasticPolicyEngine:
     def _decide(self, now: float, action: str, reason: str, **evidence) -> dict:
         decision = {"action": action, "reason": reason, **evidence}
         with self._lock:
+            if self._slo_alerts:
+                decision.setdefault(
+                    "slo_advisory", sorted(self._slo_alerts)
+                )
             self._last_decision = {**decision, "t": now}
             if action != "hold":
                 # A real action resets the dedup: the holds after it are
@@ -674,8 +712,9 @@ class ElasticPolicyEngine:
         hold_journal_interval_s — the gate is polled every pod monitor
         tick and must not flood the journal, but different workers'
         eviction-fallback holds each carry their own evidence and always
-        land."""
-        key = (reason, evidence.get("worker_id"))
+        land.  SLO advisories dedup per (reason, slo) the same way —
+        distinct SLOs firing are distinct evidence."""
+        key = (reason, evidence.get("worker_id"), evidence.get("slo"))
         with self._lock:
             last_t = self._last_hold.get(key, float("-inf"))
             if now - last_t < self.config.hold_journal_interval_s:

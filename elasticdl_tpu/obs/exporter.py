@@ -6,6 +6,10 @@ Serves the standard production surface on `--metrics_port`:
     /healthz      liveness JSON ({"status": "ok", "uptime_s": ...})
     /journal      last-N journal events as JSON (?n=, bounded tail; no
                   file paths — safe to expose beyond the master host)
+    /slo          SLO-plane snapshot (obs/slo.py): current statuses with
+                  burn-rate sparklines + bounded last-N history samples
+                  (?n=, capped; no file paths); 200 with empty statuses
+                  when no plane is wired, so old scrapers degrade soft
     /debug/vars   JSON dump of every metric + the journal's recent tail
 
 All endpoints answer HEAD with headers only (load balancers and
@@ -68,6 +72,7 @@ class MetricsExporter:
         port: int = 0,
         host: str = "",
         journal_tail: int = 100,
+        slo_plane=None,
     ):
         if registry is None or journal is None:
             from elasticdl_tpu import obs
@@ -76,6 +81,7 @@ class MetricsExporter:
             journal = journal or obs.journal()
         self._registry = registry
         self._journal = journal
+        self._slo_plane = slo_plane
         self._host = host
         self._port = port
         self._journal_tail = journal_tail
@@ -86,6 +92,11 @@ class MetricsExporter:
     @property
     def port(self) -> int:
         return self._port
+
+    def set_slo_plane(self, plane) -> None:
+        """Wire (or replace) the `SLOPlane` behind /slo — the plane is
+        built after the exporter on the master path."""
+        self._slo_plane = plane
 
     def start(self) -> "MetricsExporter":
         self._started_monotonic = time.monotonic()
@@ -115,7 +126,7 @@ class MetricsExporter:
         self._thread.start()
         logger.info(
             "Metrics exporter listening on port %d "
-            "(/metrics, /healthz, /debug/vars)", self._port,
+            "(/metrics, /healthz, /journal, /slo, /debug/vars)", self._port,
         )
         return self
 
@@ -181,6 +192,9 @@ class MetricsExporter:
     #: bounded, but a hostile/buggy scraper must not size the response.
     JOURNAL_TAIL_MAX = 1000
 
+    #: Upper bound on ?n= for /slo history samples per series.
+    SLO_SAMPLES_MAX = 128
+
     def _journal_tail_n(self, query: str) -> int:
         n = self._journal_tail
         for pair in query.split("&"):
@@ -190,6 +204,16 @@ class MetricsExporter:
                 except ValueError:
                     pass
         return max(1, min(n, self.JOURNAL_TAIL_MAX))
+
+    def _slo_samples_n(self, query: str) -> int:
+        n = 32
+        for pair in query.split("&"):
+            if pair.startswith("n="):
+                try:
+                    n = int(pair[2:])
+                except ValueError:
+                    pass
+        return max(1, min(n, self.SLO_SAMPLES_MAX))
 
     def _handle(self, request: BaseHTTPRequestHandler, head: bool = False):
         path, _, query = request.path.partition("?")
@@ -217,6 +241,21 @@ class MetricsExporter:
                     {"events": events, "count": len(events)}, default=str
                 ).encode("utf-8")
                 content_type = "application/json"
+            elif path == "/slo":
+                # Statuses + bounded history samples only — like
+                # /journal, no file paths.  200 with empty statuses when
+                # no plane is wired (old masters, workers): obs.top's
+                # SLO row degrades to absent, never to an error.
+                plane = self._slo_plane
+                if plane is None:
+                    payload = {"statuses": [], "series": [],
+                               "alerting": [], "note": "no slo plane"}
+                else:
+                    payload = plane.snapshot(
+                        samples_per_series=self._slo_samples_n(query)
+                    )
+                body = json.dumps(payload, default=str).encode("utf-8")
+                content_type = "application/json"
             elif path == "/debug/vars":
                 body = json.dumps(
                     {
@@ -233,7 +272,7 @@ class MetricsExporter:
                 status = 404
                 body = (
                     b"not found (try /metrics, /healthz, /journal, "
-                    b"/debug/vars)\n"
+                    b"/slo, /debug/vars)\n"
                 )
                 content_type = "text/plain"
         except Exception:

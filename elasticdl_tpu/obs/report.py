@@ -16,7 +16,10 @@ anatomy's data_wait/stage/compile/execute/bookkeep split from
 `step_anatomy` events, with per-worker dominant phases, straggler
 bottleneck evidence, and `profile_window` pointers at the TensorBoard
 traces covering anomalous windows), a per-rescale cost breakdown
-(detection/rendezvous/redo), and a one-line verdict ("job ran 41m,
+(detection/rendezvous/redo), an error-budget section (the SLO plane's
+``slo_status``/``slo_alert`` events replayed into a breach timeline,
+with shed-reason and goodput-phase attribution per breach), and a
+one-line verdict ("job ran 41m,
 goodput 87.3%; rescale #2 cost 93s: ...").  `--json` writes the same
 facts machine-readably.
 
@@ -216,6 +219,9 @@ def summarize(events: List[dict]) -> dict:
     freshness = _freshness_summary(events)
     if freshness:
         summary["freshness"] = freshness
+    slo = _slo_summary(events, segments)
+    if slo:
+        summary["slo"] = slo
     return summary
 
 
@@ -270,6 +276,142 @@ def _freshness_summary(events: List[dict]) -> Optional[dict]:
         ]
         if breach_lags:
             section["max_breach_lag_s"] = round(max(breach_lags), 6)
+    return section
+
+
+def _num(value) -> Optional[float]:
+    """Float when the journal field is a real number, else None (bool is
+    an int subtype; a journal is arbitrary input)."""
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    return None
+
+
+def _slo_summary(
+    events: List[dict], segments: List[dict]
+) -> Optional[dict]:
+    """Fold the SLO plane's journal events (obs/slo.py: rate-limited
+    ``slo_status`` rows, edge-triggered ``slo_alert`` fire/clear pairs)
+    into an error-budget section.  Returns None when the journal
+    predates the SLO plane, so old journals render no section at all.
+
+    Each fire/clear pair keyed by (slo, origin) becomes one breach on
+    the timeline; an unmatched fire is an OPEN breach (the job ended —
+    or the master was SIGKILLed — mid-alert).  Attribution joins two
+    taxonomies over each breach window: the ``request_shed`` reason
+    counts (which admission failure burned the budget) and the dominant
+    goodput phase (what the job was doing while it burned)."""
+    statuses = [e for e in events if e.get("event") == "slo_status"]
+    alerts = [e for e in events if e.get("event") == "slo_alert"]
+    if not (statuses or alerts):
+        return None
+    end_ts = events[-1]["ts"]
+
+    # Per-(slo, origin) budget accounting from the status stream.
+    budgets: Dict[Tuple[str, str], dict] = {}
+    for event in statuses:
+        key = (str(event.get("slo")), str(event.get("origin") or ""))
+        entry = budgets.setdefault(
+            key,
+            {
+                "slo": key[0], "origin": key[1], "status_updates": 0,
+                "min_budget_remaining_ratio": None,
+                "final_budget_remaining_ratio": None,
+                "objective": event.get("objective"),
+                "kind": event.get("kind"),
+            },
+        )
+        entry["status_updates"] += 1
+        budget = _num(event.get("budget_remaining_ratio"))
+        if budget is not None:
+            low = entry["min_budget_remaining_ratio"]
+            entry["min_budget_remaining_ratio"] = (
+                budget if low is None else min(low, budget)
+            )
+            entry["final_budget_remaining_ratio"] = budget
+
+    # Breach timeline: pair fire/clear edges per (slo, origin).
+    open_fires: Dict[Tuple[str, str], dict] = {}
+    breaches: List[dict] = []
+
+    def close_breach(fired: dict, cleared_ts: Optional[float]):
+        breaches.append(
+            {
+                "slo": str(fired.get("slo")),
+                "origin": str(fired.get("origin") or ""),
+                "grade": fired.get("grade"),
+                "fired_ts": fired["ts"],
+                "cleared_ts": cleared_ts,
+                "seconds": round(
+                    max(0.0, (cleared_ts if cleared_ts is not None
+                              else end_ts) - fired["ts"]), 6
+                ),
+                "offending": fired.get("offending"),
+                "burn_rates": fired.get("burn_rates"),
+                "budget_remaining_ratio": fired.get(
+                    "budget_remaining_ratio"
+                ),
+            }
+        )
+
+    for event in alerts:
+        key = (str(event.get("slo")), str(event.get("origin") or ""))
+        state = event.get("state")
+        if state == "fire":
+            if key in open_fires:  # double fire: journal merge/replay
+                close_breach(open_fires.pop(key), event["ts"])
+            open_fires[key] = event
+        elif state == "clear" and key in open_fires:
+            close_breach(open_fires.pop(key), event["ts"])
+        # A clear with no prior fire: the journal's head was truncated
+        # past the fire edge — nothing to attribute, skip.
+    for key in sorted(open_fires):
+        close_breach(open_fires[key], None)
+    breaches.sort(key=lambda b: b["fired_ts"])
+
+    # Attribution joins over each breach window.
+    sheds = [e for e in events if e.get("event") == "request_shed"]
+    for breach in breaches:
+        lo = breach["fired_ts"]
+        hi = breach["cleared_ts"] if breach["cleared_ts"] is not None \
+            else end_ts
+        reasons: Dict[str, int] = {}
+        for shed in sheds:
+            if lo <= shed["ts"] <= hi:
+                reason = str(shed.get("reason") or "unknown")
+                reasons[reason] = reasons.get(reason, 0) + 1
+        if reasons:
+            breach["shed_reasons"] = reasons
+        overlap: Dict[str, float] = {}
+        for seg in segments:
+            shared = min(hi, seg["end_ts"]) - max(lo, seg["start_ts"])
+            if shared > 0:
+                overlap[seg["phase"]] = (
+                    overlap.get(seg["phase"], 0.0) + shared
+                )
+        if overlap:
+            breach["dominant_goodput_phase"] = max(
+                overlap, key=overlap.get
+            )
+
+    section: dict = {
+        "status_updates": len(statuses),
+        "alert_edges": len(alerts),
+        "breaches": breaches,
+        "open_breaches": sum(
+            1 for b in breaches if b["cleared_ts"] is None
+        ),
+        "breach_s": round(sum(b["seconds"] for b in breaches), 6),
+    }
+    if budgets:
+        section["slos"] = [budgets[key] for key in sorted(budgets)]
+        floors = [
+            entry["min_budget_remaining_ratio"]
+            for entry in budgets.values()
+            if entry["min_budget_remaining_ratio"] is not None
+        ]
+        if floors:
+            section["worst_budget_remaining_ratio"] = min(floors)
     return section
 
 
@@ -599,6 +741,68 @@ def render_report(summary: dict, max_segments: int = 80) -> str:
                 )
         elif freshness["breaches"] == 0:
             lines.append("  freshness SLO: not configured")
+    slo = summary.get("slo")
+    if slo:
+        lines.append("")
+        lines.append(
+            f"error budget (SLO plane): {slo['status_updates']} status "
+            f"update(s), {len(slo['breaches'])} breach(es) totalling "
+            f"{_fmt_duration(slo['breach_s'])}"
+            + (
+                f", {slo['open_breaches']} still open"
+                if slo["open_breaches"]
+                else ""
+            )
+        )
+        for entry in slo.get("slos", ()):
+            final = entry.get("final_budget_remaining_ratio")
+            low = entry.get("min_budget_remaining_ratio")
+            where = (
+                f"@{entry['origin']}" if entry.get("origin") else ""
+            )
+            lines.append(
+                f"  {entry['slo']}{where}: budget "
+                + (
+                    f"{100 * final:.1f}% remaining"
+                    if final is not None
+                    else "n/a"
+                )
+                + (
+                    f" (low {100 * low:.1f}%)"
+                    if low is not None and low != final
+                    else ""
+                )
+                + f", {entry['status_updates']} status update(s)"
+            )
+        t0 = summary.get("start_ts", 0.0)
+        for breach in slo["breaches"]:
+            where = (
+                f"@{breach['origin']}" if breach.get("origin") else ""
+            )
+            extra = ""
+            if breach.get("offending"):
+                extra += f"; offending {breach['offending']}"
+            if breach.get("shed_reasons"):
+                shed = ", ".join(
+                    f"{reason} x{count}"
+                    for reason, count in sorted(
+                        breach["shed_reasons"].items(),
+                        key=lambda kv: -kv[1],
+                    )
+                )
+                extra += f"; shed: {shed}"
+            if breach.get("dominant_goodput_phase"):
+                extra += f"; during {breach['dominant_goodput_phase']}"
+            span = (
+                f"for {_fmt_duration(breach['seconds'])}"
+                if breach["cleared_ts"] is not None
+                else "OPEN at journal end"
+            )
+            lines.append(
+                f"    +{breach['fired_ts'] - t0:9.2f}s  "
+                f"{breach.get('grade') or 'alert':<5} "
+                f"{breach['slo']}{where} {span}{extra}"
+            )
     lines.append("")
     lines.append("timeline:")
     segments = summary["segments"]
@@ -718,6 +922,33 @@ def selftest(path: str) -> int:
                 f"task chain {chain.get('trace_id')} has negative "
                 "worker/overhead split"
             )
+    slo = summary.get("slo")
+    if slo:
+        for entry in slo.get("slos", ()):
+            for key in (
+                "min_budget_remaining_ratio",
+                "final_budget_remaining_ratio",
+            ):
+                value = entry.get(key)
+                if value is not None and not (0.0 <= value <= 1.0):
+                    problems.append(
+                        f"SLO {entry['slo']}: {key} {value} not in [0,1]"
+                    )
+        for breach in slo["breaches"]:
+            if breach["seconds"] < 0:
+                problems.append(
+                    f"SLO breach {breach['slo']} has negative duration "
+                    f"{breach['seconds']}"
+                )
+            if (
+                breach["cleared_ts"] is not None
+                and breach["cleared_ts"] < breach["fired_ts"]
+            ):
+                problems.append(
+                    f"SLO breach {breach['slo']} clears at "
+                    f"{breach['cleared_ts']} before firing at "
+                    f"{breach['fired_ts']}"
+                )
     for r in summary["rescales"]:
         parts = sum(
             r.get(k) or 0.0 for k in ("detection_s", "rendezvous_s", "redo_s")

@@ -215,6 +215,104 @@ def worker_rows(
     return rows
 
 
+#: Eight-level bar glyphs for burn-rate sparklines (SLO header rows).
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values: List[float], width: int = 24) -> str:
+    """Last-`width` values as a unicode sparkline ("" when empty)."""
+    vals = [float(v) for v in values][-max(1, int(width)):]
+    if not vals:
+        return ""
+    lo = min(vals)
+    hi = max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_CHARS[0] * len(vals)
+    top = len(_SPARK_CHARS) - 1
+    return "".join(
+        _SPARK_CHARS[min(top, int((v - lo) / span * top + 0.5))]
+        for v in vals
+    )
+
+
+def fetch_slo(base: str, tail: int = 32,
+              timeout_s: float = 5.0) -> Optional[dict]:
+    """The /slo payload, or None against masters predating the SLO
+    plane (404, connection error, non-JSON — degrade, never raise)."""
+    try:
+        payload = json.loads(
+            fetch_text(f"{base}/slo?n={tail}", timeout_s=timeout_s)
+        )
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def slo_header(payload: Optional[dict]) -> str:
+    """The SLO summary line for the header — budget remaining, worst
+    burn rate, ALERT marker — or "" when the payload is absent/empty
+    (old masters, planes with no specs)."""
+    if not isinstance(payload, dict):
+        return ""
+    statuses = payload.get("statuses")
+    if not isinstance(statuses, list) or not statuses:
+        return ""
+    min_budget = None
+    worst = None  # (burn, slo, window)
+    alerting = []
+    for status in statuses:
+        if not isinstance(status, dict):
+            continue
+        budget = status.get("budget_remaining_ratio")
+        if isinstance(budget, (int, float)) and (
+            min_budget is None or budget < min_budget
+        ):
+            min_budget = float(budget)
+        for window, burn in (status.get("burn_rates") or {}).items():
+            if isinstance(burn, (int, float)) and (
+                worst is None or burn > worst[0]
+            ):
+                worst = (float(burn), status.get("slo", "?"), window)
+        if status.get("alerting"):
+            grade = status.get("grade") or "?"
+            alerting.append(f"{status.get('slo', '?')}:{grade}")
+    if min_budget is None and worst is None:
+        return ""
+    bits = [f"slo: budget={min_budget * 100:.1f}%"
+            if min_budget is not None else "slo:"]
+    if worst is not None:
+        bits.append(f"worst_burn={worst[0]:.1f}x({worst[1]}@{worst[2]})")
+    if alerting:
+        bits.append("ALERT[" + ",".join(sorted(alerting)) + "]")
+    return "  ".join(bits)
+
+
+def slo_sparkline_notes(payload: Optional[dict],
+                        width: int = 24) -> List[str]:
+    """One per-SLO note line with the fast-window burn-rate sparkline
+    the plane ships in each status ([] when absent)."""
+    if not isinstance(payload, dict):
+        return []
+    notes = []
+    for status in payload.get("statuses") or ():
+        if not isinstance(status, dict):
+            continue
+        spark = _spark(status.get("sparkline") or [], width=width)
+        if not spark:
+            continue
+        budget = status.get("budget_remaining_ratio")
+        budget_text = (
+            f" budget={budget * 100:.1f}%"
+            if isinstance(budget, (int, float)) else ""
+        )
+        marker = " ALERT" if status.get("alerting") else ""
+        notes.append(
+            f"slo {status.get('slo', '?')}: {spark}{budget_text}{marker}"
+        )
+    return notes
+
+
 def freshness_note(events: List[dict]) -> str:
     """The freshness-SLO state line for the serving frame — "" against
     journals from masters predating the freshness plane (no
@@ -455,19 +553,28 @@ def snapshot_frame(addr: str, tail: int = 256, serving: bool = False) -> str:
         events = journal.get("events", [])
     except (urllib.error.URLError, OSError, ValueError) as exc:
         notes.append(f"(journal endpoint unavailable: {exc})")
+    # /slo is newer still: None against old masters — the SLO header
+    # row and sparklines simply don't render.
+    slo_payload = fetch_slo(base, tail=min(tail, 64))
     if serving:
         fresh = freshness_note(events)
         if fresh:
             notes.append(fresh)
+        slo_line = slo_header(slo_payload)
+        if slo_line:
+            notes.append(slo_line)
+        notes.extend(slo_sparkline_notes(slo_payload))
         return render_serving(
             serving_rows(events),
             parse_metrics(metrics_text),
             addr,
             notes=notes,
         )
+    notes.extend(slo_sparkline_notes(slo_payload))
     job_header = "  ".join(
         part
-        for part in (goodput_header(metrics_text), policy_header(events))
+        for part in (goodput_header(metrics_text), policy_header(events),
+                     slo_header(slo_payload))
         if part
     )
     return render(

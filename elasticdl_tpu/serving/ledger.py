@@ -52,7 +52,12 @@ WINDOW = 2048
 class AvailabilityLedger:
     """Process-wide accounting of request outcomes and phase time."""
 
-    def __init__(self, clock=time.monotonic):
+    def __init__(self, clock=time.monotonic, registry=None):
+        # `registry` defaults to the process obs registry (the replica
+        # path).  Tests and the SLO-plane e2e inject private registries
+        # so several replica-shaped ledgers can coexist in one process.
+        if registry is None:
+            registry = obs.registry()
         self._clock = clock
         self._lock = make_lock("AvailabilityLedger._lock")
         self._outcomes = {o: 0 for o in OUTCOMES}  # guarded-by: _lock
@@ -60,34 +65,34 @@ class AvailabilityLedger:
         self._phase_s = {p: 0.0 for p in REQUEST_PHASES}  # guarded-by: _lock
         # (finish_ts, latency_s) of recent served requests.
         self._window: deque = deque(maxlen=WINDOW)  # guarded-by: _lock
-        self._m_requests = obs.counter(
+        self._m_requests = registry.counter(
             "elasticdl_serving_requests_total",
             "Finished predict requests, by outcome",
             labelnames=("outcome",),
         )
-        self._m_rows = obs.counter(
+        self._m_rows = registry.counter(
             "elasticdl_serving_rows_total",
             "Finished predict rows, by outcome",
             labelnames=("outcome",),
         )
-        self._m_phase = obs.counter(
+        self._m_phase = registry.counter(
             "elasticdl_serving_phase_seconds_total",
             "Cumulative request wall time, by request phase",
             labelnames=("phase",),
         )
-        obs.gauge(
+        registry.gauge(
             "elasticdl_serving_availability_ratio",
             "served / all finished requests (1.0 = nothing dropped)",
         ).set_function(self.availability_ratio)
-        obs.gauge(
+        registry.gauge(
             "elasticdl_serving_latency_p50_ms",
             "p50 served-request latency over the sliding window",
         ).set_function(lambda: self.latency_percentile_ms(50.0))
-        obs.gauge(
+        registry.gauge(
             "elasticdl_serving_latency_p99_ms",
             "p99 served-request latency over the sliding window",
         ).set_function(lambda: self.latency_percentile_ms(99.0))
-        obs.gauge(
+        registry.gauge(
             "elasticdl_serving_qps",
             "Served requests/s over the sliding window",
         ).set_function(self.qps)

@@ -117,17 +117,59 @@ def parse_replica_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--warmup_features", default="",
                         help="npz file of one example request; every "
                              "padded bucket is pre-traced from it")
+    parser.add_argument("--slo_availability_target", type=float, default=0.0,
+                        help="serving-availability SLO objective (e.g. "
+                             "0.999); 0 registers no availability SLO")
+    parser.add_argument("--slo_p99_ms", type=float, default=0.0,
+                        help="p99 latency bound for the serving-latency "
+                             "SLO; 0 registers no latency SLO")
+    parser.add_argument("--slo_compliance_window_s", type=float,
+                        default=3600.0,
+                        help="rolling error-budget window for this "
+                             "replica's SLOs")
     args, unknown = parser.parse_known_args(argv)
     if unknown:
         logger.warning("Ignoring unknown replica args: %s", unknown)
     return args
 
 
+def _build_slo_plane(args):
+    """This replica's SLO plane (obs/slo.py) over the process registry.
+    The history sampler always runs (it feeds the exporter's /slo
+    sparklines); SLO specs register only when their flags opt in.
+    Ticked by the telemetry loop — one periodic thread, not two."""
+    from elasticdl_tpu.obs.slo import (
+        SLOPlane, freshness_slo, serving_availability_slo,
+        serving_latency_slo,
+    )
+
+    specs = []
+    window_s = float(args.slo_compliance_window_s)
+    if args.slo_availability_target > 0:
+        specs.append(serving_availability_slo(
+            args.slo_availability_target, compliance_window_s=window_s
+        ))
+    if args.slo_p99_ms > 0:
+        specs.append(serving_latency_slo(
+            args.slo_p99_ms, compliance_window_s=window_s
+        ))
+    if args.freshness_slo_s > 0 and args.pub_dir:
+        specs.append(freshness_slo(
+            args.freshness_slo_s, compliance_window_s=window_s
+        ))
+    return SLOPlane(specs=specs, origin=f"replica_{args.replica_id}")
+
+
 def _telemetry_loop(stop: threading.Event, interval_s: float, replica,
-                    batcher, replica_id: int):
+                    batcher, replica_id: int, slo_plane=None):
     from elasticdl_tpu.serving.ledger import ledger
 
     while not stop.wait(interval_s):
+        if slo_plane is not None:
+            try:
+                slo_plane.tick()
+            except Exception:
+                logger.exception("SLO tick failed")
         snap = ledger().snapshot()
         stats = replica.stats()
         obs.journal().record(
@@ -184,6 +226,7 @@ def main(argv=None) -> int:
     exporter = None
     watcher = None
     telemetry = None
+    slo_plane = None
     stop = threading.Event()
     try:
         if args.warmup_features:
@@ -194,7 +237,10 @@ def main(argv=None) -> int:
 
         frontend = ServingFrontend(replica, batcher, port=args.port)
         port = frontend.start()
-        exporter = MetricsExporter(port=args.metrics_port).start()
+        slo_plane = _build_slo_plane(args)
+        exporter = MetricsExporter(
+            port=args.metrics_port, slo_plane=slo_plane
+        ).start()
         write_replica_info(args.serve_dir, args.replica_id, {
             "replica_id": args.replica_id,
             "pid": os.getpid(),
@@ -221,7 +267,7 @@ def main(argv=None) -> int:
         telemetry = threading.Thread(
             target=_telemetry_loop,
             args=(stop, args.telemetry_interval_s, replica, batcher,
-                  args.replica_id),
+                  args.replica_id, slo_plane),
             name="serving-telemetry",
             daemon=True,
         )
@@ -255,6 +301,8 @@ def main(argv=None) -> int:
         batcher.stop()
         if exporter is not None:
             exporter.stop()
+        if slo_plane is not None:
+            slo_plane.stop()
         if telemetry is not None:
             telemetry.join(timeout=5)
     return 0

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -41,9 +42,97 @@ from elasticdl_tpu.serving.replica_main import live_replicas
 logger = get_logger("serving.supervisor")
 
 
+class SLOAlertFollower:
+    """Forwards replica-journaled ``slo_alert`` edges to the policy
+    engine's `note_slo_alert` advisory input.
+
+    Replicas are separate processes: their SLO planes (obs/slo.py)
+    evaluate locally and journal into the SHARED serve-dir journal.
+    The supervisor cannot get a callback across the process boundary,
+    but it CAN tail that journal — which is already the fleet-wide
+    event bus (`/journal`, `obs.top --serving`).  `poll_once()` is the
+    deterministic entry point (tests drive it directly); `start()`
+    runs it on a named daemon thread."""
+
+    def __init__(self, policy, journal=None, poll_interval_s: float = 1.0,
+                 tail_n: int = 400):
+        self._policy = policy
+        self._journal = journal if journal is not None else obs.journal()
+        self._poll_interval_s = float(poll_interval_s)
+        self._tail_n = int(tail_n)
+        # (ts, slo, origin, state) of already-forwarded edges, bounded —
+        # tail() re-serves old events every poll.
+        self._seen: set = set()
+        self._seen_order: List[tuple] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> int:
+        forwarded = 0
+        for event in self._journal.tail(self._tail_n):
+            if event.get("event") != "slo_alert":
+                continue
+            key = (event.get("ts"), event.get("slo"),
+                   event.get("origin"), event.get("state"))
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            self._seen_order.append(key)
+            while len(self._seen_order) > 4 * self._tail_n:
+                self._seen.discard(self._seen_order.pop(0))
+            evidence = {
+                k: event[k] for k in
+                ("grade", "burn_rates", "budget_remaining_ratio",
+                 "offending", "origin") if k in event
+            }
+            try:
+                self._policy.note_slo_alert(
+                    event.get("slo", ""), event.get("state") == "fire",
+                    evidence,
+                )
+                forwarded += 1
+            except Exception:
+                logger.exception("SLO alert forward failed")
+        return forwarded
+
+    def start(self) -> "SLOAlertFollower":
+        if self._thread is not None:
+            return self
+
+        def _loop():
+            while not self._stop.wait(self._poll_interval_s):
+                try:
+                    self.poll_once()
+                except Exception:
+                    logger.exception("SLO alert poll failed")
+
+        self._thread = threading.Thread(
+            target=_loop, name="slo-alert-follower", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            self._thread = None
+
+
 class ServingReplicaManager(LocalProcessManager):
     """Subprocess pod manager with replace-the-dead (not
     restart-the-world) churn semantics."""
+
+    #: Wired by start_serving_fleet when a policy engine is given; the
+    #: manager owns its teardown (stop() drains it with the fleet).
+    slo_follower: Optional[SLOAlertFollower] = None
+
+    def stop(self):
+        follower = self.slo_follower
+        if follower is not None:
+            follower.stop()
+        super().stop()
 
     def _handle_churn_serialized(self, handles: List, crashed):
         dead_ids = {h.worker_id for h, _ in crashed}
@@ -112,6 +201,9 @@ def replica_argv_fn(
     pub_dir: str = "",
     pub_poll_interval_s: float = 2.0,
     freshness_slo_s: float = 0.0,
+    slo_availability_target: float = 0.0,
+    slo_p99_ms: float = 0.0,
+    slo_compliance_window_s: float = 3600.0,
     python: str = sys.executable,
 ) -> Callable[[int], List[str]]:
     """The pod manager's `worker_argv_fn` for serving replicas: the
@@ -132,6 +224,15 @@ def replica_argv_fn(
         ]
         if warmup_features:
             cmd += ["--warmup_features", warmup_features]
+        if slo_availability_target > 0 or slo_p99_ms > 0:
+            # The replica evaluates its SLOs locally and journals the
+            # alert edges into the shared serve dir; the supervisor's
+            # SLOAlertFollower turns those into policy advisories.
+            cmd += [
+                "--slo_availability_target", str(slo_availability_target),
+                "--slo_p99_ms", str(slo_p99_ms),
+                "--slo_compliance_window_s", str(slo_compliance_window_s),
+            ]
         if pub_dir:
             # Continuous serving: each replica tracks the delta chain
             # itself (and evaluates the freshness SLO locally when set).
@@ -187,6 +288,11 @@ def start_serving_fleet(
     manager.start()
     if policy is not None:
         policy.bind(manager).start()
+        if hasattr(policy, "note_slo_alert"):
+            # The sensor->policy edge: replica slo_alert events in the
+            # shared journal become policy advisories.  The manager owns
+            # the follower's teardown (ServingReplicaManager.stop).
+            manager.slo_follower = SLOAlertFollower(policy).start()
     return manager
 
 

@@ -117,6 +117,13 @@ EVENT_REQUIRED_FIELDS = {
     "delta_checkpoint": ("step", "base_step"),
     "delta_compaction": ("step",),
     "freshness_slo": ("state", "lag_s", "slo_s"),
+    # SLO plane (obs/slo.py — docs/observability.md "SLO plane").
+    # `slo_status` is the rate-limited per-tick rollup of one SLO's
+    # error budget; `slo_alert` is the edge-triggered multi-window
+    # burn-rate fire/clear with its evidence (per-window burn rates,
+    # budget remaining, offending series).
+    "slo_status": ("slo", "budget_remaining_ratio"),
+    "slo_alert": ("slo", "state"),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -193,6 +200,10 @@ EVENT_OPTIONAL_FIELDS = {
     "policy_decision": (
         "worker_id", "flag_streak_ticks", "kill_budget_remaining",
         "evidence", "old_size", "new_size",
+        # SLO advisory evidence (note_slo_alert -> _hold): which SLOs
+        # were fired while the engine decided, plus the fire evidence.
+        "slo_advisory", "slo", "grade", "burn_rates",
+        "budget_remaining_ratio", "offending", "origin",
     ),
     "step_anatomy": (
         "totals", "fractions", "steps", "examples", "retraces", "bound",
@@ -227,6 +238,14 @@ EVENT_OPTIONAL_FIELDS = {
     "delta_checkpoint": ("rows", "tables", "event_time"),
     "delta_compaction": ("deltas_folded", "event_time"),
     "freshness_slo": ("stage", "generation", "step"),
+    "slo_status": (
+        "kind", "objective", "window_s", "bad_fraction", "burn_rates",
+        "alerting", "grade", "offending", "origin",
+    ),
+    "slo_alert": (
+        "grade", "burn_rates", "budget_remaining_ratio", "offending",
+        "windows", "origin", "objective",
+    ),
     "checkpoint_saved": ("step", "kind", "n_processes", "event_time"),
     "checkpoint_restored": ("step", "kind"),
     "checkpoint_quarantined": ("path", "reason"),
@@ -448,6 +467,27 @@ def _selftest() -> int:
          "old_generation": 2, "old_step": 4160,
          "model_dir": "/pub/delta_000000004160_000000004224",
          "reason": "ValueError('corrupt delta')"},
+        # SLO plane (obs/slo.py): the rate-limited status rollup and a
+        # fire/clear alert pair with its burn-rate evidence.
+        {"ts": 7.32, "event": "slo_status", "slo": "serving_latency",
+         "kind": "threshold", "objective": 0.99, "window_s": 3600.0,
+         "bad_fraction": 0.004, "budget_remaining_ratio": 0.6,
+         "burn_rates": {"fast_short": 0.4, "fast_long": 0.3,
+                        "slow_short": 0.3, "slow_long": 0.2},
+         "alerting": False, "grade": "", "origin": "replica_0"},
+        {"ts": 7.34, "event": "slo_alert", "slo": "serving_latency",
+         "state": "fire", "grade": "page",
+         "burn_rates": {"fast_short": 33.3, "fast_long": 18.2,
+                        "slow_short": 18.2, "slow_long": 3.3},
+         "budget_remaining_ratio": 0.12,
+         "offending": "elasticdl_serving_latency_p99_ms",
+         "origin": "replica_0"},
+        {"ts": 7.36, "event": "slo_alert", "slo": "serving_latency",
+         "state": "clear", "grade": "page",
+         "burn_rates": {"fast_short": 0.0, "fast_long": 0.1,
+                        "slow_short": 0.1, "slow_long": 1.1},
+         "budget_remaining_ratio": 0.11, "offending": "",
+         "origin": "replica_0"},
         {"ts": 7.3, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
@@ -469,6 +509,9 @@ def _selftest() -> int:
         '{"ts": 1.497, "event": "delta_checkpoint", "step": 4160}',  # no base
         '{"ts": 1.498, "event": "delta_compaction"}',           # no step
         '{"ts": 1.499, "event": "freshness_slo", "state": "breach"}',
+        '{"ts": 1.4995, "event": "slo_status", "slo": "goodput"}',  # no budget
+        '{"ts": 1.4996, "event": "slo_alert", "slo": "goodput"}',   # no state
+        '{"ts": 1.4997, "event": "slo_alert", "state": "fire"}',    # no slo
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

@@ -1,0 +1,813 @@
+"""SLO plane: metrics-history ring + error-budget burn-rate alerting.
+
+Covers (docs/observability.md "SLO plane"):
+
+- the history ring's hard bounds: label-churn eviction, clock-regression
+  clamping, counter-reset-aware deltas, quantile_over_time vs the exact
+  quantile, tick-jitter independence of rate();
+- SLOSpec validation + window scaling, ratio and threshold burn math,
+  fire/clear edge discipline, status rate-limiting, and "no data is not
+  a breach";
+- the policy engine's `note_slo_alert` advisory input (journaled holds
+  with `slo_advisory` evidence, phantom-clear drop) and the
+  supervisor's `SLOAlertFollower` journal-tail dedup;
+- the exporter's bounded `/slo` endpoint (with and without a plane,
+  HEAD, no file paths) and obs.top's SLO header/sparkline degrade;
+- obs.report's error-budget section over the golden journal and its
+  absence over pre-SLO journals;
+- the journal schema rows for `slo_status` / `slo_alert`;
+- the `slow`-marked acceptance e2e: a 2-replica (in-process) serving
+  fleet under deterministic load, an injected latency regression on one
+  replica that must page within bounded ticks, clear after the fault
+  window, ride the shared journal into a policy advisory, and replay
+  into a correctly-attributed error-budget timeline — while the
+  no-fault control run fires nothing.
+"""
+
+import importlib.util
+import json
+import os
+import random
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu import obs
+from elasticdl_tpu.master.policy import ElasticPolicyEngine, PolicyConfig
+from elasticdl_tpu.obs import report as report_mod
+from elasticdl_tpu.obs import top
+from elasticdl_tpu.obs.exporter import MetricsExporter
+from elasticdl_tpu.obs.history import MetricsHistory, _quantile
+from elasticdl_tpu.obs.metrics import MetricsRegistry
+from elasticdl_tpu.obs.slo import (
+    SLOPlane,
+    SLOSpec,
+    WINDOWS,
+    serving_availability_slo,
+    serving_latency_slo,
+)
+from elasticdl_tpu.serving.ledger import AvailabilityLedger
+from elasticdl_tpu.serving.supervisor import SLOAlertFollower
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+GOLDEN = os.path.join(TESTS_DIR, "golden_journal.jsonl")
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+@pytest.fixture
+def journal_file(tmp_path):
+    path = obs.init_journal(str(tmp_path))
+    try:
+        yield path
+    finally:
+        obs.journal().configure(None)
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_journal",
+        os.path.join(REPO_ROOT, "scripts", "validate_journal.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+# ---------------------------------------------------------------------------
+# MetricsHistory: the ring's hard bounds and window math
+# ---------------------------------------------------------------------------
+
+
+def test_history_delta_and_rate_counter_reset_aware():
+    registry = MetricsRegistry()
+    reqs = registry.counter("t_reqs_total", "", labelnames=("outcome",))
+    history = MetricsHistory(registry)
+    for tick in range(10):
+        reqs.inc(5, outcome="served")
+        history.sample(float(tick))
+    # Window [4, 9] plus the t=3 baseline anchor: 6 increments of 5.
+    assert history.delta("t_reqs_total", 5.0, now=9.0) == pytest.approx(30.0)
+    assert history.rate("t_reqs_total", 5.0, now=9.0) == pytest.approx(6.0)
+    # A counter reset (sample below its predecessor) restarts
+    # accumulation from zero instead of going negative.
+    gauge = registry.gauge("t_resetting", "")
+    for t, value in enumerate([10.0, 20.0, 5.0, 8.0]):
+        gauge.set(value)
+        history.sample(100.0 + t)
+    assert history.delta("t_resetting", 10.0, now=103.0) == pytest.approx(
+        (20.0 - 10.0) + 5.0 + (8.0 - 5.0)
+    )
+    # rate() guards the degenerate window.
+    assert history.rate("t_resetting", 0.0) == 0.0
+
+
+def test_history_label_churn_eviction_is_bounded_and_lru():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("t_churn", "", labelnames=("key",))
+    history = MetricsHistory(registry, max_series=8)
+    for i in range(40):
+        gauge.set(float(i), key=f"k{i}")
+        history.sample(float(i))
+    assert history.series_count() <= 8
+    assert history.evicted_total() >= 32
+    # Every label set stays registry-live and is refreshed each tick, so
+    # the survivors are the most-recently CREATED (insertion refreshes
+    # position); the ring never exceeds its bound regardless.
+    for i in range(40, 50):
+        gauge.set(float(i), key=f"k{i}")
+        history.sample(float(i))
+    assert history.series_count() <= 8
+
+
+def test_history_clock_regression_clamps_never_rewinds():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("t_clock", "")
+    history = MetricsHistory(registry)
+    gauge.set(1.0)
+    assert history.sample(10.0) == 10.0
+    gauge.set(2.0)
+    # A rewound clock (restarted ticker, NTP step) pins to the last
+    # accepted time — windowed queries never see negative spans.
+    assert history.sample(4.0) == 10.0
+    assert history.last_sample_time() == 10.0
+    assert history.latest("t_clock") == 2.0
+    assert history.sample(11.0) == 11.0
+
+
+def test_history_quantile_over_time_matches_exact_quantile():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("t_quant", "")
+    history = MetricsHistory(registry, max_samples=256)
+    values = [float(v) for v in range(100)]
+    for t, value in enumerate(values):
+        gauge.set(value)
+        history.sample(float(t))
+    for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+        assert history.quantile_over_time(
+            "t_quant", q, window_s=1000.0, now=99.0
+        ) == pytest.approx(_quantile(values, q))
+    # Narrow window only pools in-window samples.
+    assert history.quantile_over_time(
+        "t_quant", 0.0, window_s=9.0, now=99.0
+    ) == pytest.approx(90.0)
+    # No samples in the window -> None, not 0.0.
+    assert history.quantile_over_time(
+        "t_quant", 0.5, window_s=5.0, now=5000.0
+    ) is None
+    assert history.threshold_fraction(
+        "t_quant", 5.0, 50.0, now=5000.0
+    ) is None
+
+
+def test_history_rate_is_tick_jitter_independent():
+    """Two samplers over identical counter traffic — one regular, one
+    with jittered tick times — must agree on rate(): the delta math is
+    anchored on values, not sample counts."""
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    c_a = reg_a.counter("t_jit_total", "")
+    c_b = reg_b.counter("t_jit_total", "")
+    hist_a = MetricsHistory(reg_a)
+    hist_b = MetricsHistory(reg_b)
+    rng = random.Random(7)
+    # 10 units/s of virtual time for 30 s.
+    t_b = 0.0
+    for t in range(30):
+        c_a.inc(10)
+        hist_a.sample(float(t))
+    while t_b < 29.0:
+        step = rng.uniform(0.2, 1.8)
+        t_b = min(29.0, t_b + step)
+        c_b.inc(10 * step)
+        hist_b.sample(t_b)
+    rate_a = hist_a.rate("t_jit_total", 20.0, now=29.0)
+    rate_b = hist_b.rate("t_jit_total", 20.0, now=29.0)
+    assert rate_a == pytest.approx(10.0, rel=0.1)
+    assert rate_b == pytest.approx(10.0, rel=0.1)
+
+
+def test_history_snapshot_is_bounded():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("t_snap", "", labelnames=("key",))
+    history = MetricsHistory(registry)
+    for t in range(64):
+        for k in range(10):
+            gauge.set(float(t), key=f"k{k}")
+        history.sample(float(t))
+    snap = history.snapshot(max_series=4, samples_per_series=5)
+    assert len(snap) == 4
+    for row in snap:
+        assert len(row["points"]) <= 5
+        assert set(row) == {"metric", "kind", "labels", "points"}
+    named = history.snapshot(names=["no_such_metric"])
+    assert named == []
+
+
+# ---------------------------------------------------------------------------
+# SLOSpec validation + burn math
+# ---------------------------------------------------------------------------
+
+
+def test_slospec_validation_and_window_scaling():
+    with pytest.raises(ValueError):
+        SLOSpec(name="Bad Name", kind="ratio", objective=0.9)
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", kind="nope", objective=0.9)
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", kind="ratio", objective=1.5)
+    with pytest.raises(ValueError):
+        SLOSpec(name="x", kind="threshold", objective=0.9,
+                bad_when="sideways")
+    spec = serving_latency_slo(20.0, compliance_window_s=7200.0)
+    windows = spec.windows()
+    assert set(windows) == set(WINDOWS)
+    # 7200/8640 < min_window_s -> clamped to 5; the rest scale.
+    assert windows["fast_short"] == pytest.approx(5.0)
+    assert windows["fast_long"] == pytest.approx(10.0)
+    assert windows["slow_long"] == pytest.approx(60.0)
+    # Windows never exceed the compliance window itself.
+    tiny = serving_latency_slo(20.0, compliance_window_s=3.0)
+    assert all(w <= 3.0 for w in tiny.windows().values())
+    assert spec.budget() == pytest.approx(0.01)
+
+
+def test_ratio_slo_burn_rate_math(journal_file):
+    registry = MetricsRegistry()
+    reqs = registry.counter(
+        "elasticdl_serving_requests_total", "", labelnames=("outcome",)
+    )
+    plane = SLOPlane(
+        registry=registry,
+        specs=[serving_availability_slo(0.9, compliance_window_s=7200.0)],
+        origin="t",
+    )
+    # Steady 10% drop rate = burning the budget at exactly 1.0x.
+    for tick in range(80):
+        reqs.inc(9, outcome="served")
+        reqs.inc(1, outcome="dropped")
+        plane.tick(float(tick))
+    (status,) = plane.slos.statuses()
+    for window in WINDOWS:
+        assert status["burn_rates"][window] == pytest.approx(1.0, abs=0.05)
+    assert not status["alerting"]
+    assert not plane.slos.alerting()
+    # bad_fraction is the fraction over observed samples: a steady
+    # burn of exactly 1.0 reads as the budget fully committed.
+    assert status["bad_fraction"] == pytest.approx(0.1, abs=0.01)
+    assert status["budget_remaining_ratio"] == pytest.approx(0.0, abs=0.05)
+
+
+def test_ratio_slo_pages_and_attributes_offender(journal_file, tmp_path):
+    registry = MetricsRegistry()
+    reqs = registry.counter(
+        "elasticdl_serving_requests_total", "", labelnames=("outcome",)
+    )
+    plane = SLOPlane(
+        registry=registry,
+        specs=[serving_availability_slo(0.99, compliance_window_s=7200.0)],
+        origin="t",
+    )
+    fired_at = None
+    for tick in range(30):
+        reqs.inc(5, outcome="served")
+        if tick >= 10:
+            reqs.inc(5, outcome="shed")  # 50% bad -> burn 50x the budget
+        edges = plane.tick(float(tick))
+        if edges and fired_at is None:
+            fired_at = tick
+            (edge,) = edges
+            assert edge["state"] == "fire"
+            # The slow pair (lower threshold) can trip a tick before the
+            # fast pair; the edge is binary — no re-fire on escalation.
+            assert edge["grade"] in ("warn", "page")
+            # Attribution points at the worst non-good series.
+            assert edge["offending"] == (
+                "elasticdl_serving_requests_total{outcome=shed}"
+            )
+    assert fired_at is not None and fired_at <= 25
+    # The live grade escalates to page once both fast windows are over.
+    assert plane.slos.alerting() == {"serving_availability": "page"}
+
+
+def test_threshold_slo_no_data_is_not_a_breach(journal_file):
+    registry = MetricsRegistry()  # the latency gauge never registers
+    plane = SLOPlane(
+        registry=registry,
+        specs=[serving_latency_slo(20.0, compliance_window_s=7200.0)],
+        origin="t",
+    )
+    for tick in range(30):
+        plane.tick(float(tick))
+    (status,) = plane.slos.statuses()
+    assert not status["alerting"]
+    assert status["budget_remaining_ratio"] == 1.0
+    assert all(b == 0.0 for b in status["burn_rates"].values())
+
+
+def test_status_journaling_is_rate_limited(journal_file):
+    registry = MetricsRegistry()
+    gauge = registry.gauge("elasticdl_serving_latency_p99_ms", "")
+    plane = SLOPlane(
+        registry=registry,
+        specs=[serving_latency_slo(20.0, compliance_window_s=7200.0)],
+        status_interval_s=10.0,
+        origin="t",
+    )
+    for tick in range(100):
+        gauge.set(2.0)
+        plane.tick(float(tick))
+    statuses = [
+        e for e in _events(journal_file) if e["event"] == "slo_status"
+    ]
+    # 100 one-second ticks at a 10s status interval: ~10 rows, not 100.
+    assert 9 <= len(statuses) <= 11
+    for status in statuses:
+        assert status["slo"] == "serving_latency"
+        assert "budget_remaining_ratio" in status
+        assert status["origin"] == "t"
+
+
+def test_duplicate_spec_name_rejected():
+    registry = MetricsRegistry()
+    plane = SLOPlane(registry=registry, specs=[serving_latency_slo(20.0)])
+    with pytest.raises(ValueError):
+        plane.slos.add(serving_latency_slo(10.0))
+
+
+# ---------------------------------------------------------------------------
+# Policy advisory input + journal-tail follower
+# ---------------------------------------------------------------------------
+
+FIRE_EVIDENCE = {
+    "grade": "page",
+    "burn_rates": {"fast_short": 20.0, "fast_long": 16.0,
+                   "slow_short": 16.0, "slow_long": 3.0},
+    "budget_remaining_ratio": 0.41,
+    "offending": "elasticdl_serving_latency_p99_ms",
+    "origin": "replica_0",
+}
+
+
+def test_policy_note_slo_alert_advisory(journal_file, obs_registry_snapshot):
+    clock = FakeClock()
+    engine = ElasticPolicyEngine(PolicyConfig(), clock=clock)
+    engine.note_slo_alert("serving_latency", True, FIRE_EVIDENCE)
+    assert "serving_latency" in engine.slo_alerts()
+    clock.advance(60.0)
+    engine.note_slo_alert("serving_latency", False, {"origin": "replica_0"})
+    assert engine.slo_alerts() == {}
+    decisions = [
+        e for e in _events(journal_file) if e["event"] == "policy_decision"
+    ]
+    assert [d["reason"] for d in decisions] == [
+        "slo_alert", "slo_alert_cleared",
+    ]
+    fire = decisions[0]
+    assert fire["slo"] == "serving_latency"
+    assert fire["grade"] == "page"
+    assert fire["offending"] == "elasticdl_serving_latency_p99_ms"
+    # The advisory set rides the decision evidence while fired.
+    assert fire["slo_advisory"] == ["serving_latency"]
+    assert "slo_advisory" not in decisions[1]
+
+
+def test_policy_drops_phantom_clear(journal_file, obs_registry_snapshot):
+    engine = ElasticPolicyEngine(PolicyConfig(), clock=FakeClock())
+    # A follower replaying an old journal tail sends a clear for an SLO
+    # this engine never saw fire: no state change, no journal event.
+    engine.note_slo_alert("never_fired", False, {})
+    assert engine.slo_alerts() == {}
+    assert [
+        e for e in _events(journal_file) if e["event"] == "policy_decision"
+    ] == []
+
+
+class _RecordingPolicy:
+    def __init__(self):
+        self.calls = []
+
+    def note_slo_alert(self, slo, alerting, evidence=None):
+        self.calls.append((slo, alerting, dict(evidence or {})))
+
+
+def test_slo_alert_follower_forwards_each_edge_once(journal_file):
+    journal = obs.journal()
+    journal.record("serving_replica_start", replica_id=0, port=1)
+    journal.record("slo_alert", slo="serving_latency", state="fire",
+                   **FIRE_EVIDENCE)
+    journal.record("slo_alert", slo="serving_latency", state="clear",
+                   grade="page", origin="replica_0")
+    policy = _RecordingPolicy()
+    follower = SLOAlertFollower(policy, journal=journal)
+    assert follower.poll_once() == 2
+    # Re-polling the same tail forwards nothing new.
+    assert follower.poll_once() == 0
+    journal.record("slo_alert", slo="serving_availability", state="fire",
+                   grade="warn", origin="replica_1")
+    assert follower.poll_once() == 1
+    assert [(c[0], c[1]) for c in policy.calls] == [
+        ("serving_latency", True),
+        ("serving_latency", False),
+        ("serving_availability", True),
+    ]
+    assert policy.calls[0][2]["grade"] == "page"
+    assert policy.calls[0][2]["origin"] == "replica_0"
+
+
+def test_slo_alert_follower_survives_policy_exception(journal_file):
+    journal = obs.journal()
+    journal.record("slo_alert", slo="a_slo", state="fire", origin="r")
+    journal.record("slo_alert", slo="b_slo", state="fire", origin="r")
+
+    class ExplodingPolicy:
+        def __init__(self):
+            self.seen = []
+
+        def note_slo_alert(self, slo, alerting, evidence=None):
+            self.seen.append(slo)
+            if slo == "a_slo":
+                raise RuntimeError("boom")
+
+    policy = ExplodingPolicy()
+    follower = SLOAlertFollower(policy, journal=journal)
+    # The a_slo failure must not starve b_slo's forward.
+    follower.poll_once()
+    assert policy.seen == ["a_slo", "b_slo"]
+
+
+# ---------------------------------------------------------------------------
+# /slo endpoint + obs.top rendering
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as response:
+        return response.status, response.read()
+
+
+def test_exporter_slo_endpoint_without_plane(
+    journal_file, obs_registry_snapshot
+):
+    exporter = MetricsExporter(port=0).start()
+    try:
+        status, body = _get(f"http://127.0.0.1:{exporter.port}/slo")
+        assert status == 200
+        payload = json.loads(body)
+        # Old masters / workers: empty statuses, never an error — and
+        # obs.top renders no SLO row from this.
+        assert payload["statuses"] == []
+        assert top.slo_header(payload) == ""
+        assert top.slo_sparkline_notes(payload) == []
+    finally:
+        exporter.stop()
+
+
+def test_exporter_slo_endpoint_with_plane(tmp_path, journal_file,
+                                          obs_registry_snapshot):
+    registry = MetricsRegistry()
+    gauge = registry.gauge("elasticdl_serving_latency_p99_ms", "")
+    plane = SLOPlane(
+        registry=registry,
+        specs=[serving_latency_slo(20.0, compliance_window_s=7200.0)],
+        origin="replica_0",
+    )
+    for tick in range(40):
+        gauge.set(2.0)
+        plane.tick(float(tick))
+    exporter = MetricsExporter(port=0, slo_plane=plane).start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        status, body = _get(f"{base}/slo?n=5")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["origin"] == "replica_0"
+        assert payload["ticks"] == 40
+        (row,) = payload["statuses"]
+        assert row["slo"] == "serving_latency"
+        assert len(row["sparkline"]) <= 5
+        assert payload["series"]
+        for series in payload["series"]:
+            assert len(series["points"]) <= 5
+        # Bounded and path-free: the payload never leaks the journal dir.
+        assert str(tmp_path) not in body.decode()
+        # ?n= is clamped to SLO_SAMPLES_MAX, not trusted.
+        _, big = _get(f"{base}/slo?n=99999")
+        for series in json.loads(big)["series"]:
+            assert len(series["points"]) <= MetricsExporter.SLO_SAMPLES_MAX
+        # HEAD answers headers-only (probes HEAD before they GET).
+        request = urllib.request.Request(f"{base}/slo", method="HEAD")
+        with urllib.request.urlopen(request, timeout=5) as response:
+            assert response.status == 200
+            assert response.read() == b""
+        # 404 advertises the endpoint.
+        try:
+            _get(f"{base}/nope")
+            raise AssertionError("404 expected")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
+            assert b"/slo" in exc.read()
+        # obs.top renders the header + sparkline from the live payload.
+        fetched = top.fetch_slo(base)
+        header = top.slo_header(fetched)
+        assert header.startswith("slo: budget=100.0%")
+        assert top.slo_sparkline_notes(fetched)[0].startswith(
+            "slo serving_latency: "
+        )
+    finally:
+        exporter.stop()
+
+
+def test_top_slo_helpers_degrade():
+    # Dead port: fetch_slo returns None, helpers return empty.
+    assert top.fetch_slo("http://127.0.0.1:1", timeout_s=0.2) is None
+    assert top.slo_header(None) == ""
+    assert top.slo_sparkline_notes(None) == []
+    assert top.slo_header({"statuses": "garbage"}) == ""
+    assert top._spark([]) == ""
+    assert top._spark([1.0, 1.0, 1.0]) == "▁▁▁"
+    ramp = top._spark([0.0, 1.0, 2.0, 3.0])
+    assert len(ramp) == 4 and ramp[0] == "▁" and ramp[-1] == "█"
+    assert len(top._spark(list(range(100)), width=24)) == 24
+    header = top.slo_header({
+        "statuses": [
+            {"slo": "serving_latency", "budget_remaining_ratio": 0.41,
+             "burn_rates": {"fast_short": 20.0}, "alerting": True,
+             "grade": "page"},
+        ]
+    })
+    assert "budget=41.0%" in header
+    assert "worst_burn=20.0x(serving_latency@fast_short)" in header
+    assert "ALERT[serving_latency:page]" in header
+
+
+def test_top_frame_renders_against_master_without_slo_plane(
+    journal_file, obs_registry_snapshot
+):
+    """An old master (no /slo wired) must still render a full frame."""
+    obs.journal().record("master_start", job_name="t", port=1)
+    exporter = MetricsExporter(port=0).start()
+    try:
+        frame = top.snapshot_frame(f"127.0.0.1:{exporter.port}")
+        assert frame.startswith("elasticdl top")
+        assert "slo:" not in frame and "slo " not in frame
+    finally:
+        exporter.stop()
+
+
+# ---------------------------------------------------------------------------
+# obs.report error-budget section
+# ---------------------------------------------------------------------------
+
+
+def test_report_error_budget_section_over_golden():
+    summary = report_mod.summarize(report_mod.load_events(GOLDEN))
+    slo = summary["slo"]
+    assert slo["status_updates"] == 2
+    (breach,) = slo["breaches"]
+    assert breach["slo"] == "serving_latency"
+    assert breach["origin"] == "replica_0"
+    assert breach["grade"] == "page"
+    assert breach["seconds"] == pytest.approx(5.0)
+    assert breach["cleared_ts"] is not None
+    assert breach["offending"] == "elasticdl_serving_latency_p99_ms"
+    # Attribution: the shed inside the breach window and the phase the
+    # job was in while the budget burned.
+    assert breach["shed_reasons"] == {"queue_full": 1}
+    assert breach["dominant_goodput_phase"] == "training"
+    (entry,) = slo["slos"]
+    assert entry["min_budget_remaining_ratio"] == pytest.approx(0.39)
+    text = report_mod.render_report(summary)
+    assert "error budget (SLO plane): 2 status update(s), 1 breach(es)" \
+        in text
+    assert "page  serving_latency@replica_0 for 5.0s" in text
+    assert "shed: queue_full x1" in text
+    assert "during training" in text
+
+
+def test_report_no_slo_events_no_section(tmp_path):
+    events = [
+        e for e in report_mod.load_events(GOLDEN)
+        if e["event"] not in ("slo_status", "slo_alert")
+    ]
+    summary = report_mod.summarize(events)
+    assert "slo" not in summary
+    assert "error budget" not in report_mod.render_report(summary)
+
+
+def test_report_open_breach_and_orphan_clear(tmp_path):
+    path = tmp_path / "events.jsonl"
+    rows = [
+        {"ts": 10.0, "event": "master_start", "job_name": "t"},
+        # Orphan clear (head-truncated journal): skipped, not a breach.
+        {"ts": 11.0, "event": "slo_alert", "slo": "goodput",
+         "state": "clear", "origin": "master"},
+        {"ts": 12.0, "event": "slo_alert", "slo": "serving_latency",
+         "state": "fire", "grade": "warn", "origin": "replica_1"},
+        {"ts": 20.0, "event": "job_failed", "reason": "x"},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    summary = report_mod.summarize(report_mod.load_events(str(path)))
+    slo = summary["slo"]
+    (breach,) = slo["breaches"]
+    assert breach["cleared_ts"] is None
+    assert slo["open_breaches"] == 1
+    # Open breaches extend to the journal's end.
+    assert breach["seconds"] == pytest.approx(8.0)
+    assert "OPEN at journal end" in report_mod.render_report(summary)
+
+
+# ---------------------------------------------------------------------------
+# Journal schema rows
+# ---------------------------------------------------------------------------
+
+
+def test_validator_accepts_and_rejects_slo_rows(tmp_path):
+    validator = _load_validator()
+    good = tmp_path / "good.jsonl"
+    good.write_text(
+        json.dumps({
+            "ts": 1.0, "event": "slo_status", "slo": "serving_latency",
+            "budget_remaining_ratio": 0.5,
+            "burn_rates": {"fast_short": 1.0}, "origin": "replica_0",
+        }) + "\n" + json.dumps({
+            "ts": 2.0, "event": "slo_alert", "slo": "serving_latency",
+            "state": "fire", "grade": "page", "origin": "replica_0",
+        }) + "\n"
+    )
+    assert validator.validate_file(str(good)) == []
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        json.dumps({"ts": 1.0, "event": "slo_status", "slo": "x"}) + "\n"
+        + json.dumps({"ts": 2.0, "event": "slo_alert", "slo": "x"}) + "\n"
+        + json.dumps({"ts": 3.0, "event": "slo_alert", "state": "fire"})
+        + "\n"
+    )
+    problems = validator.validate_file(str(bad))
+    assert len(problems) == 3
+
+
+# ---------------------------------------------------------------------------
+# Acceptance e2e: 2-replica fleet, latency regression, policy advisory,
+# error-budget replay — and the no-fault control
+# ---------------------------------------------------------------------------
+
+FAULT_START, FAULT_END, TOTAL_TICKS = 60, 120, 280
+REQUESTS_PER_TICK = 100
+
+
+def _run_fleet(tmp_path, fault: bool):
+    """Two in-process 'replicas' (private registry + real
+    AvailabilityLedger + SLOPlane each) sharing one journal, a
+    deterministic loadgen, and the supervisor-side follower wired to a
+    real policy engine — the whole sensor->policy loop on a virtual
+    clock."""
+    journal_path = obs.init_journal(str(tmp_path))
+    clock = FakeClock(t=0.0)
+    engine = ElasticPolicyEngine(PolicyConfig(), clock=clock)
+    follower = SLOAlertFollower(engine, journal=obs.journal())
+    rng = random.Random(4242)
+
+    replicas = []
+    for rid in range(2):
+        registry = MetricsRegistry()
+        ledger = AvailabilityLedger(clock=clock, registry=registry)
+        plane = SLOPlane(
+            registry=registry,
+            specs=[
+                serving_latency_slo(
+                    20.0, objective=0.99, compliance_window_s=7200.0
+                ),
+                serving_availability_slo(
+                    0.999, compliance_window_s=7200.0
+                ),
+            ],
+            origin=f"replica_{rid}",
+        )
+        replicas.append((rid, ledger, plane))
+
+    fired_tick = cleared_tick = None
+    for tick in range(TOTAL_TICKS):
+        clock.advance(1.0)
+        in_fault = fault and FAULT_START <= tick < FAULT_END
+        for rid, ledger, plane in replicas:
+            for _ in range(REQUESTS_PER_TICK):
+                latency = 0.002 + rng.random() * 0.0005
+                if in_fault and rid == 0:
+                    latency = 0.05 + rng.random() * 0.01
+                ledger.record_request({"execute": latency}, "served")
+            if in_fault and rid == 0 and tick % 10 == 0:
+                # The regression also backs the queue up: a shed lands
+                # in the shared journal for breach attribution.
+                ledger.record_shed(rows=8)
+                obs.journal().record(
+                    "request_shed", reason="queue_full",
+                    queue_depth=256, queue_limit=256, rows=8,
+                )
+            plane.tick(float(tick))
+        follower.poll_once()
+        alerts = engine.slo_alerts()
+        if fired_tick is None and alerts:
+            fired_tick = tick
+        if fired_tick is not None and cleared_tick is None \
+                and tick >= FAULT_END and not alerts:
+            cleared_tick = tick
+    return journal_path, engine, fired_tick, cleared_tick
+
+
+@pytest.mark.slow
+def test_slo_e2e_fleet_latency_regression_pages_and_clears(
+    tmp_path, obs_registry_snapshot
+):
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    try:
+        journal_path, engine, fired_tick, cleared_tick = _run_fleet(
+            fleet_dir, fault=True
+        )
+        # Fast-window reaction: paged within 20 ticks of fault onset.
+        assert fired_tick is not None
+        assert FAULT_START < fired_tick <= FAULT_START + 20, fired_tick
+        # ... and cleared after the fault window drained through the
+        # ledger's sliding percentile + the slow burn windows.
+        assert cleared_tick is not None, "alert never cleared"
+        assert engine.slo_alerts() == {}
+
+        events = _events(journal_path)
+        alerts = [e for e in events if e["event"] == "slo_alert"]
+        assert [a["state"] for a in alerts] == ["fire", "clear"]
+        assert all(a["origin"] == "replica_0" for a in alerts)
+        assert alerts[0]["grade"] == "page"
+        assert alerts[0]["offending"] == "elasticdl_serving_latency_p99_ms"
+        # Only the faulted replica's latency SLO fired — availability
+        # stayed green on both replicas, latency stayed green on 1.
+        assert {a["slo"] for a in alerts} == {"serving_latency"}
+
+        # The sensor->policy edge: the follower's forward journaled
+        # advisory policy decisions carrying the SLO evidence.
+        decisions = [
+            e for e in events if e["event"] == "policy_decision"
+        ]
+        fires = [d for d in decisions if d.get("reason") == "slo_alert"]
+        assert fires and fires[0]["slo"] == "serving_latency"
+        assert fires[0]["slo_advisory"] == ["serving_latency"]
+        assert fires[0]["origin"] == "replica_0"
+        assert any(
+            d.get("reason") == "slo_alert_cleared" for d in decisions
+        )
+
+        # The journal schema-validates end to end.
+        validator = _load_validator()
+        assert validator.validate_file(journal_path) == []
+
+        # obs.report reconstructs the error-budget timeline with
+        # attribution from the same journal.
+        summary = report_mod.summarize(report_mod.load_events(journal_path))
+        slo = summary["slo"]
+        (breach,) = slo["breaches"]
+        assert breach["slo"] == "serving_latency"
+        assert breach["origin"] == "replica_0"
+        assert breach["grade"] == "page"
+        assert breach["cleared_ts"] is not None
+        assert breach["cleared_ts"] >= breach["fired_ts"]
+        assert breach["shed_reasons"]["queue_full"] >= 1
+        assert slo["open_breaches"] == 0
+        text = report_mod.render_report(summary)
+        assert "error budget (SLO plane)" in text
+        assert "serving_latency@replica_0" in text
+    finally:
+        obs.journal().configure(None)
+
+    # Control: identical fleet and loadgen, no fault — zero alerts.
+    control_dir = tmp_path / "control"
+    control_dir.mkdir()
+    try:
+        journal_path, engine, fired_tick, _cleared = _run_fleet(
+            control_dir, fault=False
+        )
+        assert fired_tick is None
+        assert engine.slo_alerts() == {}
+        events = _events(journal_path)
+        assert [e for e in events if e["event"] == "slo_alert"] == []
+        assert [
+            e for e in events if e["event"] == "policy_decision"
+        ] == []
+        # Statuses still flowed (the sensors ran; they just saw green).
+        assert [e for e in events if e["event"] == "slo_status"]
+    finally:
+        obs.journal().configure(None)

@@ -603,6 +603,8 @@ def test_telemetry_call_sites_pass_cardinality_rule():
             "obs/telemetry.py",
             "obs/top.py",
             "obs/stepstats.py",
+            "obs/history.py",
+            "obs/slo.py",
             "obs/tracing.py",
             "obs/trace.py",
             "master/servicer.py",
