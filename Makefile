@@ -101,9 +101,12 @@ test-stream: stream-gates
 # Script gate of the serving plane, shared by test-serving and
 # test-fast: the load generator's no-server selftest (stream
 # determinism + hot-key skew, outcome classification, closed/open-loop
-# accounting against a fake backend).
+# accounting against a fake backend), plus the client-tracing half
+# (deterministic trace ids, the --slowest waterfall table joined from
+# sampled request_trace events).
 serving-gates:
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --selftest
+	JAX_PLATFORMS=cpu python scripts/loadgen.py --selftest --slowest 3
 
 # Standalone async-staging-engine gate (docs/design.md "Async staging
 # engine"): parse-pool ordering/determinism under jitter, prefetcher
@@ -118,10 +121,13 @@ test-pipeline:
 # micro-batcher units (latency-budget vs batch-size race, shed-on-full,
 # deadline drops), padded-bucket no-retrace under the RetraceWatcher,
 # in-process hot-swap equivalence, and — without `-m 'not slow'` — the
-# supervised-fleet acceptance e2e (live hot-swap with zero dropped
-# in-flight, SIGKILL relaunch, journal schema validation).
+# supervised-fleet acceptance e2es (live hot-swap with zero dropped
+# in-flight, SIGKILL relaunch, journal schema validation; the traced
+# stall run whose slow-request waterfall, report attribution, and
+# alert exemplars must all name the queue phase).
 test-serving: serving-gates
-	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+	       tests/test_request_tracing.py -q
 
 # Script gates of the sparse path, shared by test-sparse and test-fast:
 # the xla-vs-fused microbench's interpret-mode selftest and a tiny

@@ -222,7 +222,76 @@ def summarize(events: List[dict]) -> dict:
     slo = _slo_summary(events, segments)
     if slo:
         summary["slo"] = slo
+    tail = _tail_latency_summary(events)
+    if tail:
+        summary["tail_latency"] = tail
     return summary
+
+
+#: Rows in the "tail latency attribution" exemplar table.
+TOP_TAIL_EXEMPLARS = 5
+
+
+def _tail_latency_summary(events: List[dict]) -> Optional[dict]:
+    """Fold the serving sampler's ``request_trace`` events
+    (serving/ledger.py ExemplarSampler: head/tail/outcome-sampled
+    request records with per-phase latency splits) into a tail-latency
+    attribution section.  Returns None when the journal predates
+    request tracing, so old journals render no section at all.
+
+    The slowest exemplars ARE the p99 evidence — the sampler journals
+    everything above its SLO-tied threshold, so the top of this table
+    is the top of the true latency distribution, decomposed by phase
+    (queue/batch/execute/respond) to name what the tail is made of."""
+    traces = [e for e in events if e.get("event") == "request_trace"]
+    if not traces:
+        return None
+    by_reason: Dict[str, int] = {}
+    outcomes: Dict[str, int] = {}
+    for event in traces:
+        reason = str(event.get("sampled_by") or "unknown")
+        by_reason[reason] = by_reason.get(reason, 0) + 1
+        outcome = str(event.get("outcome") or "unknown")
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+    ranked = sorted(
+        (e for e in traces if _num(e.get("latency_ms")) is not None),
+        key=lambda e: -float(e["latency_ms"]),
+    )
+    exemplars = []
+    phase_ms: Dict[str, float] = {}
+    for event in ranked[:TOP_TAIL_EXEMPLARS]:
+        exemplar = {
+            key: event.get(key)
+            for key in (
+                "trace_id", "latency_ms", "dominant_phase", "outcome",
+                "sampled_by", "replica_id", "generation", "bucket",
+                "phases",
+            )
+            if event.get(key) is not None
+        }
+        exemplars.append(exemplar)
+        phases = event.get("phases")
+        if isinstance(phases, dict):
+            for phase, value in phases.items():
+                ms = _num(value)
+                if ms is not None and ms >= 0:
+                    phase_ms[phase] = phase_ms.get(phase, 0.0) + ms
+    section: dict = {
+        "sampled": len(traces),
+        "by_reason": by_reason,
+        "outcomes": outcomes,
+        "exemplars": exemplars,
+    }
+    total = sum(phase_ms.values())
+    if total > 0:
+        section["phase_ms"] = {
+            p: round(v, 3) for p, v in sorted(phase_ms.items())
+        }
+        section["phase_fractions"] = {
+            p: round(v / total, 4) for p, v in sorted(phase_ms.items())
+        }
+        section["dominant_phase"] = max(phase_ms, key=phase_ms.get)
+    return section
 
 
 def _freshness_summary(events: List[dict]) -> Optional[dict]:
@@ -803,6 +872,42 @@ def render_report(summary: dict, max_segments: int = 80) -> str:
                 f"{breach.get('grade') or 'alert':<5} "
                 f"{breach['slo']}{where} {span}{extra}"
             )
+    tail = summary.get("tail_latency")
+    if tail:
+        lines.append("")
+        reasons = ", ".join(
+            f"{reason} x{count}"
+            for reason, count in sorted(
+                tail["by_reason"].items(), key=lambda kv: -kv[1]
+            )
+        )
+        lines.append(
+            f"tail latency attribution ({tail['sampled']} sampled "
+            f"request trace(s); {reasons}):"
+        )
+        if tail.get("dominant_phase"):
+            split = ", ".join(
+                f"{phase} {100 * fraction:.0f}%"
+                for phase, fraction in sorted(
+                    tail["phase_fractions"].items(), key=lambda kv: -kv[1]
+                )
+            )
+            lines.append(
+                f"  p99 exemplars decompose as: {split}  "
+                f"<- dominant {tail['dominant_phase']}"
+            )
+        for exemplar in tail["exemplars"]:
+            extra = ""
+            if exemplar.get("dominant_phase"):
+                extra += f"  dominant {exemplar['dominant_phase']}"
+            if exemplar.get("replica_id") is not None:
+                extra += f"  (replica {exemplar['replica_id']})"
+            lines.append(
+                f"    {exemplar['latency_ms']:>9.1f}ms  "
+                f"trace {exemplar.get('trace_id')}  "
+                f"[{exemplar.get('outcome')}/{exemplar.get('sampled_by')}]"
+                f"{extra}"
+            )
     lines.append("")
     lines.append("timeline:")
     segments = summary["segments"]
@@ -949,6 +1054,25 @@ def selftest(path: str) -> int:
                     f"{breach['cleared_ts']} before firing at "
                     f"{breach['fired_ts']}"
                 )
+    tail = summary.get("tail_latency")
+    if tail:
+        fractions = tail.get("phase_fractions")
+        if fractions:
+            fraction_sum = sum(fractions.values())
+            if abs(fraction_sum - 1.0) > 0.02:
+                problems.append(
+                    "tail-latency phase fractions sum to "
+                    f"{fraction_sum:.4f}, not ~1.0"
+                )
+        latencies = [e["latency_ms"] for e in tail["exemplars"]]
+        if latencies != sorted(latencies, reverse=True):
+            problems.append(
+                f"tail exemplars not sorted slowest-first: {latencies}"
+            )
+        if any(ms < 0 for ms in latencies):
+            problems.append(f"negative exemplar latency: {latencies}")
+        if sum(tail["by_reason"].values()) != tail["sampled"]:
+            problems.append("tail-latency reason counts != sampled total")
     for r in summary["rescales"]:
         parts = sum(
             r.get(k) or 0.0 for k in ("detection_s", "rendezvous_s", "redo_s")

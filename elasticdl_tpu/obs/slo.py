@@ -224,6 +224,7 @@ class SLORegistry:
         self._statuses: Dict[str, dict] = {}  # guarded-by: _lock
         self._last_status_t = float("-inf")  # guarded-by: _lock
         self._callbacks: List[Callable[[str, bool, dict], None]] = []  # guarded-by: _lock
+        self._exemplar_provider: Optional[Callable[[str], List[str]]] = None  # guarded-by: _lock
         registry = history.registry
         self._g_burn = registry.gauge(
             "elasticdl_slo_burn_rate",
@@ -258,6 +259,19 @@ class SLORegistry:
         """fn(slo_name, alerting, evidence) on every fire/clear edge."""
         with self._lock:
             self._callbacks.append(fn)
+
+    def set_exemplar_provider(
+        self, fn: Callable[[str], List[str]]
+    ) -> None:
+        """fn(slo_name) -> trace ids attached to FIRE edges as evidence.
+
+        Wired by the serving replica to its ExemplarSampler so a latency
+        page carries the slowest sampled request trace ids — resolvable
+        in the Perfetto trace built from the same journal.  Trace ids
+        ride the alert event/evidence (unbounded values), never a metric
+        label (metric-label-cardinality rule)."""
+        with self._lock:
+            self._exemplar_provider = fn
 
     def specs(self) -> List[SLOSpec]:
         with self._lock:
@@ -373,6 +387,7 @@ class SLORegistry:
                 elif status["alerting"]:
                     self._alerting[name] = status["grade"]
             callbacks = list(self._callbacks)
+            exemplar_provider = self._exemplar_provider
         for status in statuses:
             name = status["slo"]
             for wname, burn in status["burn_rates"].items():
@@ -396,6 +411,14 @@ class SLORegistry:
                     origin=status["origin"],
                 )
         for edge in edges:
+            exemplars: List[str] = []
+            if edge["state"] == "fire" and exemplar_provider is not None:
+                try:
+                    exemplars = [str(t) for t
+                                 in exemplar_provider(edge["slo"]) if t]
+                except Exception:
+                    logger.exception("SLO exemplar provider failed")
+            extra = {"exemplars": exemplars} if exemplars else {}
             journal.record(
                 "slo_alert",
                 slo=edge["slo"],
@@ -405,6 +428,7 @@ class SLORegistry:
                 budget_remaining_ratio=edge["budget_remaining_ratio"],
                 offending=edge["offending"],
                 origin=edge["origin"],
+                **extra,
             )
             if edge["state"] == "fire":
                 logger.warning(
@@ -423,6 +447,8 @@ class SLORegistry:
                 "offending": edge["offending"],
                 "origin": edge["origin"],
             }
+            if exemplars:
+                evidence["exemplars"] = exemplars
             for fn in callbacks:
                 try:
                     fn(edge["slo"], edge["state"] == "fire", evidence)

@@ -71,6 +71,17 @@ _SERVING_COLUMNS = (
     "P99(ms)", "QUEUE", "INFLT", "AVAIL%", "SERVED", "SHED", "ERR",
 )
 
+#: Per-phase p99 split columns (request-level tracing): telemetry field
+#: -> column header.  Rendered only when the journal carries the fields
+#: (replicas newer than the request-tracing plane) — against older
+#: journals the frame is byte-identical to the pre-tracing layout.
+_SERVING_PHASE_COLUMNS = (
+    ("queue_p99_ms", "QU(ms)"),
+    ("batch_p99_ms", "BA(ms)"),
+    ("execute_p99_ms", "EX(ms)"),
+    ("respond_p99_ms", "RE(ms)"),
+)
+
 
 def fetch_text(url: str, timeout_s: float = 5.0) -> str:
     with urllib.request.urlopen(url, timeout=timeout_s) as response:
@@ -395,6 +406,11 @@ def serving_rows(
                 "errors": event.get("errors", 0),
             }
         )
+        for field, _label in _SERVING_PHASE_COLUMNS:
+            rows[-1][field] = event.get(field)
+        exemplar = event.get("exemplar")
+        if isinstance(exemplar, dict):
+            rows[-1]["exemplar"] = exemplar
     return rows
 
 
@@ -412,29 +428,45 @@ def render_serving(
     lines = [
         f"elasticdl top (serving) — {addr}  " + "  ".join(header_bits),
     ]
-    table: List[Tuple[str, ...]] = [_SERVING_COLUMNS]
-    for row in rows:
-        table.append(
-            (
-                str(row["replica"]),
-                f"{row['age_s']:.1f}",
-                str(row["generation"]),
-                str(row["step"]),
-                "-" if row.get("fresh_s") is None else f"{row['fresh_s']:.1f}",
-                f"{row['qps']:.1f}",
-                _fixed_ms(row["p50_ms"]),
-                _fixed_ms(row["p99_ms"]),
-                str(row["queue_depth"]),
-                str(row["inflight"]),
-                str(row["availability_pct"]),
-                str(row["served"]),
-                str(row["shed"]),
-                str(row["errors"]),
-            )
+    # The per-phase p99 split renders only when some replica journals
+    # it (post-request-tracing); old journals get the old frame.
+    has_phases = any(
+        row.get(field) is not None
+        for row in rows
+        for field, _label in _SERVING_PHASE_COLUMNS
+    )
+    columns = _SERVING_COLUMNS
+    if has_phases:
+        columns = columns + tuple(
+            label for _field, label in _SERVING_PHASE_COLUMNS
         )
+    table: List[Tuple[str, ...]] = [columns]
+    for row in rows:
+        cells = (
+            str(row["replica"]),
+            f"{row['age_s']:.1f}",
+            str(row["generation"]),
+            str(row["step"]),
+            "-" if row.get("fresh_s") is None else f"{row['fresh_s']:.1f}",
+            f"{row['qps']:.1f}",
+            _fixed_ms(row["p50_ms"]),
+            _fixed_ms(row["p99_ms"]),
+            str(row["queue_depth"]),
+            str(row["inflight"]),
+            str(row["availability_pct"]),
+            str(row["served"]),
+            str(row["shed"]),
+            str(row["errors"]),
+        )
+        if has_phases:
+            cells = cells + tuple(
+                _fixed_ms(row.get(field))
+                for field, _label in _SERVING_PHASE_COLUMNS
+            )
+        table.append(cells)
     widths = [
         max(len(line[col]) for line in table)
-        for col in range(len(_SERVING_COLUMNS))
+        for col in range(len(columns))
     ]
     for line in table:
         lines.append(
@@ -445,6 +477,22 @@ def render_serving(
         lines.append(
             "(no serving_telemetry events in the journal tail — is this a "
             "training-only master?)"
+        )
+    exemplars = [
+        (row["replica"], row["exemplar"])
+        for row in rows
+        if isinstance(row.get("exemplar"), dict)
+        and isinstance(row["exemplar"].get("latency_ms"), (int, float))
+    ]
+    if exemplars:
+        rid, slowest = max(
+            exemplars, key=lambda pair: pair[1]["latency_ms"]
+        )
+        dominant = slowest.get("dominant_phase") or "-"
+        lines.append(
+            f"slowest sampled request: trace {slowest.get('trace_id')} "
+            f"{float(slowest['latency_ms']):.1f}ms dominant {dominant} "
+            f"(replica {rid}; resolve with obs.trace)"
         )
     for note in notes or ():
         lines.append(note)

@@ -532,6 +532,43 @@ def assemble(paths: List[str]) -> dict:
     }
 
 
+#: Serving waterfall order (request-level tracing, docs/observability.md
+#: "Request tracing & exemplars"): the client's send span roots the
+#: trace, the replica's rpc.predict nests under it, phases nest below.
+SERVING_SPAN_ORDER = (
+    "client.predict", "rpc.predict", "serve.queue", "serve.batch",
+    "serve.execute", "serve.respond",
+)
+
+
+def request_chain(spans: List[dict], trace_id: str) -> List[dict]:
+    """The ordered serving waterfall for ONE traced request: client send
+    (when the loadgen journal is merged in) -> rpc.predict ->
+    serve.queue -> shared serve.batch -> serve.execute -> serve.respond.
+
+    The shared batch span is journaled ONCE per batch and carries no
+    trace id (it belongs to every member request equally); member spans
+    point at it through their ``batch_span_id`` arg, so the hop is
+    resolved here by id rather than by trace membership.  Returns []
+    for an unknown trace id."""
+    members = [s for s in spans if s["trace_id"] == trace_id]
+    if not members:
+        return []
+    by_id = {s["span_id"]: s for s in spans}
+    chain = list(members)
+    linked = {s["span_id"] for s in members}
+    for span in members:
+        batch_id = span.get("args", {}).get("batch_span_id", "")
+        if batch_id and batch_id not in linked:
+            batch = by_id.get(batch_id)
+            if batch is not None:
+                chain.append(batch)
+                linked.add(batch_id)
+    rank = {name: i for i, name in enumerate(SERVING_SPAN_ORDER)}
+    chain.sort(key=lambda s: (rank.get(s["name"], len(rank)), s["start"]))
+    return chain
+
+
 def span_children(spans: List[dict]) -> Dict[str, List[dict]]:
     children: Dict[str, List[dict]] = {}
     for span in spans:
@@ -598,6 +635,9 @@ def _selftest() -> int:
     gate the pipeline's invariants (the `make test-obs` hook):
     - the midpoint estimator recovers the injected offsets;
     - the dispatch -> rpc -> execute -> report chain reconstructs;
+    - the serving waterfall (client.predict -> rpc.predict -> queue ->
+      shared serve.batch -> execute -> respond) reconstructs for every
+      member of a batch, with ONE shared batch span between them;
     - zero negative durations / child-escaping-parent spans survive;
     - the Chrome trace schema-validates."""
     import tempfile
@@ -628,6 +668,41 @@ def _selftest() -> int:
             {"ts": T0 + 9.5, "event": "phase_transition",
              "from": "training", "to": "idle", "seconds": 9.0},
         ]
+        # Serving request traces: two member requests of ONE batch —
+        # the shared serve.batch span is journaled once (no trace_id)
+        # and both members hop to it via batch_span_id.
+        S0 = T0 + 20.0
+        for i, rtrace in enumerate(("lg-req-1", "lg-req-2")):
+            enq = S0 + 0.001 * i
+            events.extend([
+                {"ts": S0 + 0.1, "event": "span", "name": "client.predict",
+                 "start_ts": enq - 0.001, "duration_s": 0.055,
+                 "span_id": rtrace, "trace_id": rtrace, "proc": "loadgen"},
+                {"ts": S0 + 0.1, "event": "span", "name": "rpc.predict",
+                 "start_ts": enq - 0.0005, "duration_s": 0.052,
+                 "span_id": f"s-rpc-{i}", "parent_span_id": rtrace,
+                 "trace_id": rtrace, "proc": "replica_0", "rows": 4,
+                 "outcome": "served", "batch_span_id": "s-batch-1"},
+                {"ts": S0 + 0.1, "event": "span", "name": "serve.queue",
+                 "start_ts": enq, "duration_s": 0.04,
+                 "trace_id": rtrace, "span_id": f"s-q-{i}",
+                 "parent_span_id": f"s-rpc-{i}", "proc": "replica_0"},
+                {"ts": S0 + 0.1, "event": "span", "name": "serve.execute",
+                 "start_ts": enq + 0.042, "duration_s": 0.008,
+                 "trace_id": rtrace, "span_id": f"s-x-{i}",
+                 "parent_span_id": "s-batch-1",
+                 "batch_span_id": "s-batch-1", "proc": "replica_0"},
+                {"ts": S0 + 0.1, "event": "span", "name": "serve.respond",
+                 "start_ts": enq + 0.050, "duration_s": 0.001,
+                 "trace_id": rtrace, "span_id": f"s-r-{i}",
+                 "parent_span_id": f"s-rpc-{i}", "proc": "replica_0"},
+            ])
+        events.append(
+            {"ts": S0 + 0.1, "event": "span", "name": "serve.batch",
+             "start_ts": S0 + 0.0405, "duration_s": 0.011,
+             "span_id": "s-batch-1", "proc": "replica_0",
+             "batch_rows": 8, "bucket": 8, "generation": 1,
+             "requests": 2})
         # Telemetry ingests pairing with each worker's probes: the
         # master stamp lands mid-round-trip (symmetric 20ms legs).
         for wid, skew in SKEWS.items():
@@ -740,6 +815,20 @@ def _selftest() -> int:
                     f"outside aligned root "
                     f"[{root['start']:.3f}, {root['end']:.3f}]"
                 )
+    for rtrace in ("lg-req-1", "lg-req-2"):
+        names = [s["name"] for s in request_chain(result["spans"], rtrace)]
+        if names != list(SERVING_SPAN_ORDER):
+            failures.append(
+                f"serving waterfall for {rtrace}: {names} != "
+                f"{list(SERVING_SPAN_ORDER)}"
+            )
+    batch_ids = {
+        s["span_id"] for s in result["spans"] if s["name"] == "serve.batch"
+    }
+    if batch_ids != {"s-batch-1"}:
+        failures.append(f"expected ONE shared batch span, got {batch_ids}")
+    if "replica_0" not in {s["proc"] for s in result["spans"]}:
+        failures.append("replica_0 proc row missing from assembled spans")
     render_waterfall(result["spans"])  # must not raise
     if failures:
         print("trace selftest FAILED:", file=sys.stderr)

@@ -39,7 +39,9 @@ import numpy as np
 
 from elasticdl_tpu import obs
 from elasticdl_tpu.analysis.runtime import make_lock
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.obs import tracing
 
 logger = get_logger("serving.batcher")
 
@@ -84,6 +86,16 @@ class _Pending:
     # Phase clocks filled in by the batcher thread (queue/batch/execute/
     # respond — obs/stepstats.REQUEST_PHASES).
     phases: Dict[str, float] = field(default_factory=dict)
+    # Request-trace context (client-propagated trace id + the frontend's
+    # rpc.predict span id) and the WALL-clock enqueue stamp — phase
+    # durations ride the monotonic clock above, but deferred span
+    # records need a common wall timescale (obs/tracing.py).
+    trace_id: str = ""
+    parent_span_id: str = ""
+    enqueued_ts: float = 0.0
+    # The shared serve.batch span payload for the dispatch this request
+    # rode (one minted span per batch; every member points at it).
+    batch_info: Optional[dict] = None
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self.done.wait(timeout):
@@ -179,10 +191,15 @@ class MicroBatcher:
         self,
         features: Dict[str, np.ndarray],
         deadline_s: Optional[float] = None,
+        trace_id: str = "",
+        parent_span_id: str = "",
     ) -> _Pending:
         """Admit one request (all arrays share axis-0 row count).  Raises
         QueueFullError when the admission queue is at capacity — the
-        explicit shed, never a silent unbounded backlog."""
+        explicit shed, never a silent unbounded backlog.  ``trace_id``
+        and ``parent_span_id`` (the caller's rpc.predict span) ride the
+        pending record so sampled requests can journal their phase spans
+        after the fact."""
         rows = int(np.asarray(next(iter(features.values()))).shape[0])
         if rows > self._config.max_batch_size:
             raise ValueError(
@@ -195,6 +212,9 @@ class MicroBatcher:
             rows=rows,
             enqueued_at=now,
             deadline=(now + deadline_s) if deadline_s else None,
+            trace_id=str(trace_id),
+            parent_span_id=str(parent_span_id),
+            enqueued_ts=time.time(),
         )
         with self._lock:
             if self._stopped:
@@ -228,10 +248,15 @@ class MicroBatcher:
         features: Dict[str, np.ndarray],
         deadline_s: Optional[float] = None,
         wait_timeout_s: Optional[float] = 60.0,
+        trace_id: str = "",
+        parent_span_id: str = "",
     ) -> np.ndarray:
         """submit + wait, the synchronous convenience used by the
         frontend's request handler threads."""
-        return self.submit(features, deadline_s).wait(wait_timeout_s)
+        return self.submit(
+            features, deadline_s,
+            trace_id=trace_id, parent_span_id=parent_span_id,
+        ).wait(wait_timeout_s)
 
     # -- the batcher thread ---------------------------------------------
 
@@ -299,14 +324,30 @@ class MicroBatcher:
             )
             for key in live[0].features
         }
+        wall_batch = time.time()
         padded, _ = pad_and_stage(stacked, rows, self._buckets)
+        bucket = bucket_for(rows, self._buckets)
         t_exec = self._clock()
         batch_s = t_exec - t_batch
         self._m_batch_rows.observe(float(rows))
         try:
+            # Chaos site: a `serving.execute` latency fault stalls the
+            # batcher thread (the queue piles up behind it — the
+            # injected-queue-stall e2e); an error fault fails the batch.
+            spec = faults.fire("serving.execute")
+            if spec is not None:
+                if spec.kind == "latency":
+                    time.sleep(float(spec.arg or 0.1))
+                elif spec.kind == "error":
+                    raise RuntimeError(
+                        f"FAULT INJECTION: serving execute failed "
+                        f"({spec.arg or 'error'})"
+                    )
             outputs = np.asarray(self._execute_fn(padded, rows))
         except Exception as exc:
             t_done = self._clock()
+            self._stamp_batch(live, wall_batch, batch_s, t_done - t_exec,
+                              rows, bucket)
             for req in live:
                 req.phases["batch"] = batch_s
                 req.phases["execute"] = t_done - t_exec
@@ -315,6 +356,7 @@ class MicroBatcher:
             raise
         t_respond = self._clock()
         execute_s = t_respond - t_exec
+        self._stamp_batch(live, wall_batch, batch_s, execute_s, rows, bucket)
         offset = 0
         for req in live:
             req.phases["batch"] = batch_s
@@ -322,6 +364,27 @@ class MicroBatcher:
             result = outputs[offset:offset + req.rows]
             offset += req.rows
             self._finish(req, result, None, outcome="served")
+
+    def _stamp_batch(self, live: List[_Pending], wall_batch: float,
+                     batch_s: float, execute_s: float, rows: int,
+                     bucket: int):
+        """Attach ONE shared serve.batch span payload to every traced
+        member of a dispatch (a minted span id, never journaled here —
+        the exemplar sampler journals it once iff a member samples, so
+        span cost stays O(sampled))."""
+        if not any(r.trace_id for r in live):
+            return
+        info = {
+            "name": "serve.batch",
+            "start_ts": wall_batch,
+            "duration_s": batch_s + execute_s,
+            "span_id": tracing.tracer().mint_span_id(),
+            "batch_rows": rows,
+            "bucket": bucket,
+            "requests": len(live),
+        }
+        for req in live:
+            req.batch_info = info
 
     def _finish(self, req: _Pending, result, error, outcome: str):
         t0 = self._clock()

@@ -30,6 +30,7 @@ import io
 import json
 import os
 import threading
+import time
 from typing import Dict, Optional
 
 import grpc
@@ -37,6 +38,7 @@ import numpy as np
 
 from elasticdl_tpu.common import grpc_utils
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.obs import tracing
 from elasticdl_tpu.serving.batcher import MicroBatcher, QueueFullError
 
 logger = get_logger("serving.frontend")
@@ -86,11 +88,22 @@ def _identity(b: bytes) -> bytes:
 
 class PredictServicer:
     """Request handlers running on the gRPC thread pool; the batcher
-    thread owns the device, so handlers only block on `_Pending.wait`."""
+    thread owns the device, so handlers only block on `_Pending.wait`.
 
-    def __init__(self, replica, batcher: MicroBatcher):
+    Request tracing: a client-propagated trace id
+    (``TRACE_METADATA_KEY``) makes this handler the server edge of the
+    request's trace — an ``rpc.predict`` span covering the whole RPC,
+    parented under the client's span (``SPAN_METADATA_KEY``, the trace
+    root by the loadgen convention).  Spans are NOT journaled inline:
+    the completed request (every outcome, including queue-full sheds
+    that never reach the batcher) feeds the ``ExemplarSampler``
+    (serving/ledger.py), which journals the span set only for sampled
+    requests — O(sampled), never O(requests)."""
+
+    def __init__(self, replica, batcher: MicroBatcher, sampler=None):
         self._replica = replica
         self._batcher = batcher
+        self._sampler = sampler
 
     def predict(self, request: bytes, context) -> bytes:
         try:
@@ -103,27 +116,133 @@ class PredictServicer:
         deadline_s = None
         if remaining is not None and remaining < 3600:
             deadline_s = max(0.0, remaining - _DEADLINE_HEADROOM_S)
+        trace_id = grpc_utils.trace_id_from_context(context)
+        client_span_id = grpc_utils.span_id_from_context(context)
+        rpc_span_id = tracing.tracer().mint_span_id() if trace_id else ""
+        start_ts = time.time()
+        start_mono = time.monotonic()
+        req = None
+        outcome = "served"
+        abort = None  # deferred (code, message): observe BEFORE abort raises
+        outputs = None
         try:
-            outputs = self._batcher.predict(
+            req = self._batcher.submit(
                 features,
                 deadline_s=deadline_s,
-                wait_timeout_s=(remaining if remaining is not None else 60.0),
+                trace_id=trace_id,
+                parent_span_id=rpc_span_id,
             )
+            outputs = req.wait(remaining if remaining is not None else 60.0)
         except QueueFullError as exc:
-            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+            outcome = "shed"
+            abort = (grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
         except TimeoutError as exc:
-            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
+            outcome = "dropped"
+            abort = (grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
         except ValueError as exc:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            outcome = "error"
+            abort = (grpc.StatusCode.INVALID_ARGUMENT, str(exc))
         except RuntimeError as exc:
             # RequestError: dropped on deadline in queue, or execute failed.
-            code = (
-                grpc.StatusCode.DEADLINE_EXCEEDED
-                if "deadline" in str(exc)
-                else grpc.StatusCode.INTERNAL
-            )
-            context.abort(code, str(exc))
+            if "deadline" in str(exc):
+                outcome = "dropped"
+                abort = (grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
+            else:
+                outcome = "error"
+                abort = (grpc.StatusCode.INTERNAL, str(exc))
+        self._observe_trace(
+            trace_id, client_span_id, rpc_span_id, req, outcome,
+            start_ts, max(0.0, time.monotonic() - start_mono),
+        )
+        if abort is not None:
+            context.abort(*abort)
         return encode_array(outputs)
+
+    def _observe_trace(self, trace_id: str, client_span_id: str,
+                       rpc_span_id: str, req, outcome: str,
+                       start_ts: float, duration_s: float):
+        """Assemble the request's deferred span set — rpc.predict, the
+        phase spans derived from the batcher's stamps, the shared
+        serve.batch link — and feed the sampler.  All clocks were read
+        by the handler/batcher already; a failure here must never fail
+        the RPC."""
+        sampler = self._sampler
+        if sampler is None or not trace_id:
+            return
+        try:
+            phases = dict(req.phases) if req is not None else {}
+            rows = req.rows if req is not None else 1
+            rpc_span = {
+                "name": "rpc.predict",
+                "start_ts": start_ts,
+                "duration_s": duration_s,
+                "trace_id": trace_id,
+                "span_id": rpc_span_id,
+                "parent_id": client_span_id,
+                "rows": rows,
+                "outcome": outcome,
+            }
+            spans = [rpc_span]
+            batch = None
+            bucket = None
+            if req is not None and "queue" in phases:
+                spans.append({
+                    "name": "serve.queue",
+                    "start_ts": req.enqueued_ts,
+                    "duration_s": phases["queue"],
+                    "trace_id": trace_id,
+                    "parent_id": rpc_span_id,
+                })
+            if req is not None and req.batch_info is not None:
+                batch = dict(req.batch_info)
+                bucket = batch.get("bucket")
+                rpc_span["batch_span_id"] = batch["span_id"]
+                exec_start = req.enqueued_ts + phases.get("queue", 0.0) \
+                    + phases.get("batch", 0.0)
+                if "execute" in phases:
+                    exec_span_id = tracing.tracer().mint_span_id()
+                    spans.append({
+                        "name": "serve.execute",
+                        "start_ts": exec_start,
+                        "duration_s": phases["execute"],
+                        "trace_id": trace_id,
+                        "span_id": exec_span_id,
+                        "parent_id": batch["span_id"],
+                        "batch_span_id": batch["span_id"],
+                        "rows": rows,
+                    })
+                    if "respond" in phases:
+                        # Parented under rpc.predict, NOT the execute
+                        # span: respond starts where execute ends, and
+                        # the assembler's monotonic clamp would squash a
+                        # child that lives past its parent's end.
+                        spans.append({
+                            "name": "serve.respond",
+                            "start_ts": exec_start + phases["execute"],
+                            "duration_s": phases["respond"],
+                            "trace_id": trace_id,
+                            "parent_id": rpc_span_id,
+                        })
+            generation = None
+            try:
+                generation = int(self._replica.generation.gen_id)
+            except Exception:
+                pass
+            if batch is not None and generation is not None:
+                batch["generation"] = generation
+            sampler.observe(
+                trace_id,
+                phases,
+                outcome,
+                rows=rows,
+                latency_s=(duration_s if not phases else None),
+                spans=spans,
+                batch=batch,
+                generation=generation,
+                bucket=bucket,
+            )
+        except Exception:
+            logger.exception("request-trace observe failed")
 
     def reload(self, request: bytes, context) -> bytes:
         try:
@@ -198,8 +317,9 @@ class ServingFrontend:
         batcher: MicroBatcher,
         port: int = 0,
         max_workers: int = 16,
+        sampler=None,
     ):
-        self._servicer = PredictServicer(replica, batcher)
+        self._servicer = PredictServicer(replica, batcher, sampler=sampler)
         self._server = grpc_utils.build_server(max_workers=max_workers)
         add_PredictServicer_to_server(self._servicer, self._server)
         self._requested_port = port
@@ -242,7 +362,13 @@ class PredictClient:
         self,
         features: Dict[str, np.ndarray],
         deadline_s: Optional[float] = None,
+        trace_id: str = "",
+        span_id: str = "",
     ) -> np.ndarray:
+        """``trace_id``/``span_id`` ride the call metadata
+        (``TRACE_METADATA_KEY``/``SPAN_METADATA_KEY``) so the server's
+        rpc.predict span joins the caller's trace; empty sends none —
+        wire-compatible with pre-tracing servers."""
         policy = self._policy
         if deadline_s is not None:
             policy = grpc_utils.RetryPolicy(
@@ -257,6 +383,7 @@ class PredictClient:
             policy=policy,
             stats=self._stats,
             seed=self._addr,
+            metadata=grpc_utils.trace_metadata(trace_id, span_id),
         )
         return decode_array(payload)
 

@@ -10,6 +10,18 @@ finished request books:
 - its per-phase seconds over the request-phase taxonomy
   (obs/stepstats.REQUEST_PHASES: queue / batch / execute / respond).
 
+The ledger also hosts the request-level tracing sensor
+(``ExemplarSampler`` below): tracing every request at production QPS is
+unaffordable, so completed request records land in a bounded in-memory
+ring and only SAMPLED requests journal — a deterministic 1-in-N head
+sample (the steady-state waterfall supply), every request whose latency
+crosses the SLO-p99-tied tail threshold, and every non-served outcome
+(shed/dropped/error are always evidence).  Journaling cost is therefore
+O(sampled), never O(requests), and the decision is pure in the request
+stream (a counter, a threshold — no wall-clock randomness).  Trace ids
+are unbounded identifiers: they ride the journal (``request_trace``
+events, span records) and never metric labels (cardinality rule).
+
 Exported via the obs registry (scraped by the replica's exporter and
 rendered by ``obs.top --serving``):
 
@@ -63,7 +75,9 @@ class AvailabilityLedger:
         self._outcomes = {o: 0 for o in OUTCOMES}  # guarded-by: _lock
         self._rows = {o: 0 for o in OUTCOMES}  # guarded-by: _lock
         self._phase_s = {p: 0.0 for p in REQUEST_PHASES}  # guarded-by: _lock
-        # (finish_ts, latency_s) of recent served requests.
+        # (finish_ts, latency_s, phases) of recent served requests; the
+        # per-request phases dict feeds the per-phase p99 split that
+        # obs.top --serving renders as QU/BA/EX/RE columns.
         self._window: deque = deque(maxlen=WINDOW)  # guarded-by: _lock
         self._m_requests = registry.counter(
             "elasticdl_serving_requests_total",
@@ -119,7 +133,7 @@ class AvailabilityLedger:
                 if phase in phases:
                     self._phase_s[phase] += float(phases[phase])
             if outcome == "served":
-                self._window.append((now, latency))
+                self._window.append((now, latency, dict(phases)))
         self._m_requests.inc(outcome=outcome)
         self._m_rows.inc(int(rows), outcome=outcome)
         for phase in REQUEST_PHASES:
@@ -151,7 +165,7 @@ class AvailabilityLedger:
 
     def latency_percentile_ms(self, pct: float) -> float:
         with self._lock:
-            latencies = sorted(latency for _, latency in self._window)
+            latencies = sorted(latency for _, latency, _ in self._window)
         if not latencies:
             return 0.0
         rank = min(
@@ -159,10 +173,28 @@ class AvailabilityLedger:
         )
         return latencies[rank] * 1e3
 
+    def phase_percentile_ms(self, pct: float) -> Dict[str, float]:
+        """Per-phase percentile over the served sliding window — the
+        p99 phase-attribution split ("p99 is mostly queue")."""
+        with self._lock:
+            samples = [phases for _, _, phases in self._window]
+        split: Dict[str, float] = {}
+        for phase in REQUEST_PHASES:
+            values = sorted(float(p.get(phase, 0.0)) for p in samples)
+            if not values:
+                split[phase] = 0.0
+                continue
+            rank = min(
+                len(values) - 1,
+                int(round(pct / 100.0 * (len(values) - 1))),
+            )
+            split[phase] = values[rank] * 1e3
+        return split
+
     def qps(self, horizon_s: float = 10.0) -> float:
         now = self._clock()
         with self._lock:
-            recent = [ts for ts, _ in self._window if now - ts <= horizon_s]
+            recent = [ts for ts, _, _ in self._window if now - ts <= horizon_s]
         if not recent:
             return 0.0
         span = max(1e-6, now - min(recent))
@@ -180,8 +212,196 @@ class AvailabilityLedger:
             "availability_ratio": round(self.availability_ratio(), 6),
             "p50_ms": round(self.latency_percentile_ms(50.0), 3),
             "p99_ms": round(self.latency_percentile_ms(99.0), 3),
+            "phase_p99_ms": {
+                p: round(v, 3)
+                for p, v in self.phase_percentile_ms(99.0).items()
+            },
             "qps": round(self.qps(), 2),
         }
+
+
+# ---------------------------------------------------------------------------
+# Tail-based exemplar sampler (request-level tracing sensor)
+# ---------------------------------------------------------------------------
+
+
+class ExemplarSampler:
+    """Bounded ring of completed request records with a three-policy
+    sampling decision (docs/observability.md "Request tracing &
+    exemplars"):
+
+    - **head**: deterministic 1-in-``head_every`` of traced requests
+      (a counter, not a coin flip — the same request stream always
+      journals the same head set);
+    - **tail**: latency above ``tail_threshold_ms`` (wired to the
+      replica's ``--slo_p99_ms`` target, so "slow" means "slow against
+      the SLO the fleet pages on");
+    - **outcome**: every shed / dropped / error request (failures are
+      always evidence).
+
+    A sampled request journals one ``request_trace`` event plus its
+    deferred span set (``rpc.predict`` -> ``serve.queue`` ->
+    ``serve.execute`` -> ``serve.respond``), and the shared
+    ``serve.batch`` span its bucket rode — journaled ONCE per batch, on
+    the first sampled member (a bounded id ring dedupes).  Unsampled
+    requests write nothing: journaling stays O(sampled).
+
+    Requests without a trace id (clients that sent no
+    ``TRACE_METADATA_KEY``) are invisible to the sampler — there is no
+    id to journal, and skipping them keeps the head counter pure in the
+    *traced* stream.
+
+    All clocks are read by the CALLER (frontend/batcher host code) and
+    arrive as wall stamps inside the prepared span payloads; this class
+    only counts, compares, and journals — nothing here runs inside
+    traced/jitted code (trace-purity rule).
+    """
+
+    def __init__(
+        self,
+        head_every: int = 128,
+        tail_threshold_ms: float = 0.0,
+        capacity: int = 64,
+        replica_id: Optional[int] = None,
+        journal=None,
+    ):
+        self._head_every = max(0, int(head_every))
+        self._tail_threshold_ms = float(tail_threshold_ms)
+        self._capacity = max(1, int(capacity))
+        self._replica_id = replica_id
+        self._journal = journal
+        self._lock = make_lock("ExemplarSampler._lock")
+        self._count = 0  # traced requests seen, guarded-by: _lock
+        self._sampled = 0  # guarded-by: _lock
+        self._ring: deque = deque(maxlen=self._capacity)  # guarded-by: _lock
+        # Shared-batch-span dedup: ids already journaled (bounded LRU;
+        # no deque maxlen — eviction must also clean the set).
+        self._batch_ids: deque = deque()  # guarded-by: _lock
+        self._batch_id_set = set()  # guarded-by: _lock
+
+    def _journal_ref(self):
+        if self._journal is not None:
+            return self._journal
+        return obs.journal()
+
+    # -- the sampling decision ------------------------------------------
+
+    def observe(
+        self,
+        trace_id: str,
+        phases: Dict[str, float],
+        outcome: str,
+        rows: int = 1,
+        latency_s: Optional[float] = None,
+        spans=None,
+        batch: Optional[dict] = None,
+        generation: Optional[int] = None,
+        bucket: Optional[int] = None,
+    ) -> str:
+        """Feed one completed request; returns the sampling reason
+        (``head`` / ``tail`` / ``outcome``) or ``""`` when unsampled.
+
+        ``spans`` is the deferred span payload list (record_span kwargs,
+        prepared by the frontend with wall stamps already read);
+        ``batch`` is the shared serve.batch payload (must carry
+        ``span_id``).  Both journal only on a sample."""
+        if not trace_id:
+            return ""
+        if latency_s is None:
+            latency_s = sum(
+                float(phases.get(p, 0.0)) for p in REQUEST_PHASES
+            )
+        latency_ms = float(latency_s) * 1e3
+        with self._lock:
+            self._count += 1
+            if outcome != "served":
+                sampled_by = "outcome"
+            elif (
+                self._tail_threshold_ms > 0
+                and latency_ms > self._tail_threshold_ms
+            ):
+                sampled_by = "tail"
+            elif (
+                self._head_every > 0
+                and (self._count - 1) % self._head_every == 0
+            ):
+                sampled_by = "head"
+            else:
+                return ""
+            self._sampled += 1
+            batch_is_new = False
+            if batch is not None and batch.get("span_id"):
+                batch_id = batch["span_id"]
+                if batch_id not in self._batch_id_set:
+                    batch_is_new = True
+                    self._batch_ids.append(batch_id)
+                    self._batch_id_set.add(batch_id)
+                    while len(self._batch_ids) > self._capacity:
+                        self._batch_id_set.discard(self._batch_ids.popleft())
+            phases_ms = {
+                p: round(float(phases[p]) * 1e3, 3)
+                for p in REQUEST_PHASES
+                if p in phases
+            }
+            dominant = (
+                max(phases_ms, key=phases_ms.get) if phases_ms else ""
+            )
+            record = {
+                "trace_id": trace_id,
+                "outcome": outcome,
+                "sampled_by": sampled_by,
+                "latency_ms": round(latency_ms, 3),
+                "phases": phases_ms,
+                "dominant_phase": dominant,
+                "rows": int(rows),
+            }
+            self._ring.append(dict(record))
+        # Journal OUTSIDE the lock: the journal has its own lock and a
+        # slow disk must not serialize the gRPC handler threads here.
+        extra = {}
+        if self._replica_id is not None:
+            extra["replica_id"] = self._replica_id
+        if generation is not None:
+            extra["generation"] = generation
+        if bucket is not None:
+            extra["bucket"] = bucket
+        self._journal_ref().record("request_trace", **record, **extra)
+        from elasticdl_tpu.obs import tracing
+
+        if batch_is_new:
+            tracing.record_span(**batch)
+        for payload in spans or ():
+            tracing.record_span(**payload)
+        return sampled_by
+
+    # -- readouts -------------------------------------------------------
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {"observed": self._count, "sampled": self._sampled}
+
+    def exemplars(self) -> list:
+        """Ring contents, oldest first (bounded copies)."""
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def slowest(self) -> Optional[dict]:
+        """The slowest request currently in the ring (the obs.top
+        footer / serving_telemetry ``exemplar`` field)."""
+        with self._lock:
+            if not self._ring:
+                return None
+            return dict(max(self._ring, key=lambda r: r["latency_ms"]))
+
+    def trace_ids(self, k: int = 4) -> list:
+        """Up to ``k`` exemplar trace ids, slowest first — the
+        offending-request evidence a fired latency ``slo_alert``
+        attaches."""
+        with self._lock:
+            ranked = sorted(
+                self._ring, key=lambda r: -r["latency_ms"]
+            )
+        return [r["trace_id"] for r in ranked[: max(0, int(k))]]
 
 
 _ledger: Optional[AvailabilityLedger] = None

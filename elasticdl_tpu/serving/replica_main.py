@@ -127,6 +127,17 @@ def parse_replica_args(argv=None) -> argparse.Namespace:
                         default=3600.0,
                         help="rolling error-budget window for this "
                              "replica's SLOs")
+    parser.add_argument("--trace_head_every", type=int, default=128,
+                        help="deterministic head-sampling period of the "
+                             "request-trace exemplar sampler (1-in-N "
+                             "traced requests journal; 0 disables head "
+                             "samples)")
+    parser.add_argument("--trace_exemplar_capacity", type=int, default=64,
+                        help="bounded in-memory exemplar ring size")
+    parser.add_argument("--trace_tail_threshold_ms", type=float, default=0.0,
+                        help="tail-exemplar latency threshold; 0 ties it "
+                             "to --slo_p99_ms (the SLO the fleet pages "
+                             "on defines 'slow')")
     args, unknown = parser.parse_known_args(argv)
     if unknown:
         logger.warning("Ignoring unknown replica args: %s", unknown)
@@ -161,7 +172,8 @@ def _build_slo_plane(args):
 
 
 def _telemetry_loop(stop: threading.Event, interval_s: float, replica,
-                    batcher, replica_id: int, slo_plane=None):
+                    batcher, replica_id: int, slo_plane=None,
+                    sampler=None):
     from elasticdl_tpu.serving.ledger import ledger
 
     while not stop.wait(interval_s):
@@ -172,6 +184,19 @@ def _telemetry_loop(stop: threading.Event, interval_s: float, replica,
                 logger.exception("SLO tick failed")
         snap = ledger().snapshot()
         stats = replica.stats()
+        phase_p99 = snap.get("phase_p99_ms", {})
+        extra = {}
+        if sampler is not None:
+            slowest = sampler.slowest()
+            if slowest is not None:
+                # Bounded exemplar pointer (trace id is journal-only per
+                # the cardinality rule): what obs.top --serving prints
+                # in its footer line.
+                extra["exemplar"] = {
+                    "trace_id": slowest["trace_id"],
+                    "latency_ms": slowest["latency_ms"],
+                    "dominant_phase": slowest["dominant_phase"],
+                }
         obs.journal().record(
             "serving_telemetry",
             replica_id=replica_id,
@@ -183,11 +208,16 @@ def _telemetry_loop(stop: threading.Event, interval_s: float, replica,
             qps=snap["qps"],
             p50_ms=snap["p50_ms"],
             p99_ms=snap["p99_ms"],
+            queue_p99_ms=phase_p99.get("queue", 0.0),
+            batch_p99_ms=phase_p99.get("batch", 0.0),
+            execute_p99_ms=phase_p99.get("execute", 0.0),
+            respond_p99_ms=phase_p99.get("respond", 0.0),
             availability_ratio=snap["availability_ratio"],
             served=snap["counts"]["served"],
             dropped=snap["counts"]["dropped"],
             shed=snap["counts"]["shed"],
             errors=snap["counts"]["error"],
+            **extra,
         )
 
 
@@ -196,11 +226,21 @@ def main(argv=None) -> int:
     os.makedirs(args.serve_dir, exist_ok=True)
     obs.init_journal(args.serve_dir)
 
+    from elasticdl_tpu.common import faults
+    from elasticdl_tpu.obs import tracing
     from elasticdl_tpu.obs.exporter import MetricsExporter
     from elasticdl_tpu.serving.batcher import BatcherConfig, MicroBatcher
     from elasticdl_tpu.serving.frontend import ServingFrontend, decode_features
-    from elasticdl_tpu.serving.ledger import ledger
+    from elasticdl_tpu.serving.ledger import ExemplarSampler, ledger
     from elasticdl_tpu.serving.runtime import ServingReplica
+
+    if faults.install_from_env():
+        logger.warning("Replica %d: fault injection armed from env",
+                       args.replica_id)
+    # Name this process on the assembled trace: span records carry their
+    # own `proc`, so every replica gets its own Perfetto pid row even
+    # though the whole fleet appends to ONE serve-dir journal.
+    tracing.set_process(f"replica_{args.replica_id}")
 
     replica = ServingReplica(
         args.model_dir,
@@ -218,6 +258,13 @@ def main(argv=None) -> int:
         on_request=book.record_request,
         on_shed=book.record_shed,
     ).start()
+    tail_ms = args.trace_tail_threshold_ms or args.slo_p99_ms
+    sampler = ExemplarSampler(
+        head_every=args.trace_head_every,
+        tail_threshold_ms=tail_ms,
+        capacity=args.trace_exemplar_capacity,
+        replica_id=args.replica_id,
+    )
     # Every resource below owns a daemon thread and/or a listening
     # socket; a failure anywhere between start() and the serve loop
     # (warmup decode, bind error, pub_dir scan) must still drain them
@@ -235,9 +282,14 @@ def main(argv=None) -> int:
             replica.warmup(example, batcher.buckets)
             logger.info("Warmed %d bucket shapes", len(batcher.buckets))
 
-        frontend = ServingFrontend(replica, batcher, port=args.port)
+        frontend = ServingFrontend(replica, batcher, port=args.port,
+                                   sampler=sampler)
         port = frontend.start()
         slo_plane = _build_slo_plane(args)
+        # Latency pages carry evidence: the slowest sampled trace ids at
+        # fire time, resolvable in the Perfetto trace from this journal.
+        slo_plane.slos.set_exemplar_provider(
+            lambda _slo: sampler.trace_ids(4))
         exporter = MetricsExporter(
             port=args.metrics_port, slo_plane=slo_plane
         ).start()
@@ -267,7 +319,7 @@ def main(argv=None) -> int:
         telemetry = threading.Thread(
             target=_telemetry_loop,
             args=(stop, args.telemetry_interval_s, replica, batcher,
-                  args.replica_id, slo_plane),
+                  args.replica_id, slo_plane, sampler),
             name="serving-telemetry",
             daemon=True,
         )

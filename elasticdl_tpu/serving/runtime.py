@@ -452,6 +452,11 @@ class ServingReplica:
 
     @property
     def generation(self) -> Generation:
+        """The currently-serving generation.  Besides the hot-swap
+        plane, request tracing reads ``generation.gen_id`` per sampled
+        request (frontend.py) so exemplars journaled across a swap
+        attribute their latency to the model that actually served
+        them."""
         with self._lock:
             return self._generation
 

@@ -204,6 +204,9 @@ def replica_argv_fn(
     slo_availability_target: float = 0.0,
     slo_p99_ms: float = 0.0,
     slo_compliance_window_s: float = 3600.0,
+    trace_head_every: int = 128,
+    trace_exemplar_capacity: int = 64,
+    trace_tail_threshold_ms: float = 0.0,
     python: str = sys.executable,
 ) -> Callable[[int], List[str]]:
     """The pod manager's `worker_argv_fn` for serving replicas: the
@@ -240,6 +243,15 @@ def replica_argv_fn(
                 "--pub_dir", pub_dir,
                 "--pub_poll_interval_s", str(pub_poll_interval_s),
                 "--freshness_slo_s", str(freshness_slo_s),
+            ]
+        if (trace_head_every != 128 or trace_exemplar_capacity != 64
+                or trace_tail_threshold_ms > 0):
+            # Only forwarded when tuned away from the replica defaults,
+            # so pre-tracing argv pins stay byte-identical.
+            cmd += [
+                "--trace_head_every", str(trace_head_every),
+                "--trace_exemplar_capacity", str(trace_exemplar_capacity),
+                "--trace_tail_threshold_ms", str(trace_tail_threshold_ms),
             ]
         return cmd
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -91,6 +92,133 @@ class RequestStream:
         )
         cat = np.where(hot, hot_ids, cold_ids).astype(np.int32)
         return {"dense": dense, "cat": cat}
+
+
+# ---------------------------------------------------------------------------
+# Request tracing (client half)
+# ---------------------------------------------------------------------------
+
+
+def trace_id_for(seed: int, i: int) -> str:
+    """Deterministic wire trace id for request i of a seeded run: pure
+    in (seed, i), so a replay regenerates the SAME ids and a journal
+    from run A can be queried with ids computed offline."""
+    return f"lg{seed}-{i:08d}"
+
+
+class ClientTracer:
+    """Client half of request-level tracing: mints the deterministic
+    trace id for each request, keeps the per-request latency record,
+    and journals one ``client.predict`` ROOT span per request
+    (span_id == trace_id — the replica's rpc.predict parents under it
+    via the gRPC metadata, common/grpc_utils.py).
+
+    With ``journal_dir`` set the spans land in the serve dir's SHARED
+    events.jsonl, so ``obs.trace <serve_dir>`` merges client and
+    replica spans into one waterfall with a ``loadgen`` pid row."""
+
+    def __init__(self, seed: int = 0, journal_dir: str = ""):
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._records: List[dict] = []  # guarded-by: _lock
+        self._tracing = None
+        if journal_dir:
+            from elasticdl_tpu import obs
+            from elasticdl_tpu.obs import tracing
+
+            obs.init_journal(journal_dir)
+            tracing.set_process("loadgen")
+            self._tracing = tracing
+
+    def trace_id(self, i: int) -> str:
+        return trace_id_for(self.seed, i)
+
+    def record(self, i: int, outcome: str, start_wall: float,
+               latency_s: float):
+        trace_id = self.trace_id(i)
+        with self._lock:
+            self._records.append({
+                "i": i,
+                "trace_id": trace_id,
+                "outcome": outcome,
+                "latency_ms": round(latency_s * 1e3, 3),
+            })
+        if self._tracing is not None:
+            self._tracing.record_span(
+                "client.predict", start_wall, latency_s,
+                trace_id=trace_id, span_id=trace_id, root=True,
+                outcome=outcome,
+            )
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def slowest(self, n: int) -> List[dict]:
+        return sorted(
+            self.records(), key=lambda r: -r["latency_ms"]
+        )[:max(0, n)]
+
+
+#: --slowest waterfall: phase order on the wire and one glyph per phase
+#: (the bar is proportional — `qqqqqbxr` reads as queue-dominated).
+_WATERFALL_PHASES = ("queue", "batch", "execute", "respond")
+_PHASE_GLYPHS = {"queue": "q", "batch": "b", "execute": "x", "respond": "r"}
+
+
+def render_slowest(
+    records: List[dict],
+    events: Optional[List[dict]] = None,
+    top: int = 5,
+    width: int = 40,
+) -> str:
+    """The ``--slowest N`` table: trace ids + latency for the N slowest
+    requests, each with a phase waterfall joined from the journal's
+    sampled ``request_trace`` events when available.  The server-side
+    sampler (serving/ledger.py) journals EVERY request above its tail
+    threshold, so genuinely slow rows nearly always join; head-sampled
+    fast rows may not — the line still prints, without the bar."""
+    events = events or []
+    by_trace: Dict[str, dict] = {}
+    for event in events:
+        if event.get("event") == "request_trace" and event.get("trace_id"):
+            by_trace[str(event["trace_id"])] = event
+    ranked = sorted(records, key=lambda r: -r["latency_ms"])[:max(0, top)]
+    lines = [f"slowest {len(ranked)} request(s):"]
+    joined_any = False
+    for rec in ranked:
+        lines.append(
+            f"  {rec['latency_ms']:>9.1f}ms  trace {rec['trace_id']}  "
+            f"[{rec['outcome']}]"
+        )
+        joined = by_trace.get(rec["trace_id"])
+        phases = joined.get("phases") if joined else None
+        if not isinstance(phases, dict) or not phases:
+            continue
+        joined_any = True
+        known = {
+            p: float(phases[p])
+            for p in _WATERFALL_PHASES
+            if isinstance(phases.get(p), (int, float)) and phases[p] >= 0
+        }
+        total = sum(known.values()) or 1.0
+        bar = "".join(
+            _PHASE_GLYPHS[p] * max(1, int(round(width * known[p] / total)))
+            for p in _WATERFALL_PHASES
+            if known.get(p)
+        )
+        split = " ".join(f"{p}={known[p]:.1f}ms" for p in known)
+        dominant = joined.get("dominant_phase", "")
+        lines.append(
+            f"             |{bar:<{width}.{width}}|  {split}"
+            + (f"  <- {dominant}" if dominant else "")
+        )
+    if ranked and not joined_any:
+        lines.append(
+            "  (no request_trace events joined — phase waterfalls need "
+            "the serve-dir journal written by the replicas' sampler)"
+        )
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
@@ -192,11 +320,19 @@ def classify_error(exc: BaseException) -> str:
 
 
 def _issue(predict_fn, stream: RequestStream, i: int, result: LoadResult,
-           lock: threading.Lock, clock=time.monotonic):
+           lock: threading.Lock, clock=time.monotonic,
+           trace: Optional[ClientTracer] = None):
     features = stream.request(i)
+    trace_id = trace.trace_id(i) if trace is not None else ""
+    start_wall = time.time()
     t0 = clock()
     try:
-        predict_fn(features)
+        if trace_id:
+            # The client span IS the trace root: span_id == trace_id
+            # rides the metadata so the server parents under it.
+            predict_fn(features, trace_id=trace_id, span_id=trace_id)
+        else:
+            predict_fn(features)
         outcome = "served"
     except Exception as exc:  # outcome-classified, never fatal
         outcome = classify_error(exc)
@@ -206,6 +342,8 @@ def _issue(predict_fn, stream: RequestStream, i: int, result: LoadResult,
         result.outcomes[outcome] += 1
     if outcome == "served":
         result.histogram.record(latency)
+    if trace is not None:
+        trace.record(i, outcome, start_wall, latency)
 
 
 def run_closed_loop(
@@ -214,6 +352,7 @@ def run_closed_loop(
     num_requests: int,
     concurrency: int = 1,
     clock=time.monotonic,
+    trace: Optional[ClientTracer] = None,
 ) -> LoadResult:
     """`concurrency` workers issue back-to-back until `num_requests`
     total have been sent.  Request indices are deterministic per worker
@@ -224,7 +363,7 @@ def run_closed_loop(
 
     def worker(w: int):
         for i in range(w, num_requests, concurrency):
-            _issue(predict_fn, stream, i, result, lock, clock)
+            _issue(predict_fn, stream, i, result, lock, clock, trace)
 
     threads = [
         threading.Thread(target=worker, args=(w,),
@@ -247,6 +386,7 @@ def run_open_loop(
     max_outstanding: int = 256,
     clock=time.monotonic,
     sleep=time.sleep,
+    trace: Optional[ClientTracer] = None,
 ) -> LoadResult:
     """Paced arrivals: request i is issued at t_start + i/target_qps on
     its own thread (arrivals independent of completions).  If more than
@@ -264,7 +404,7 @@ def run_open_loop(
 
     def issue_one(i: int):
         try:
-            _issue(predict_fn, stream, i, result, lock, clock)
+            _issue(predict_fn, stream, i, result, lock, clock, trace)
         finally:
             outstanding.release()
 
@@ -298,11 +438,11 @@ def round_robin_predict(predict_fns: Sequence[Callable]) -> Callable:
     counter = {"i": 0}
     lock = threading.Lock()
 
-    def predict(features):
+    def predict(features, **kwargs):
         with lock:
             i = counter["i"]
             counter["i"] += 1
-        return predict_fns[i % len(predict_fns)](features)
+        return predict_fns[i % len(predict_fns)](features, **kwargs)
 
     return predict
 
@@ -312,9 +452,11 @@ def round_robin_predict(predict_fns: Sequence[Callable]) -> Callable:
 # ---------------------------------------------------------------------------
 
 
-def _selftest() -> int:
+def _selftest(slowest: int = 0) -> int:
     """No-server sanity: stream determinism + skew, outcome
-    classification, and a closed+open loop against a fake backend."""
+    classification, a closed+open loop against a fake backend, and the
+    request-tracing client half (deterministic trace ids, per-request
+    records, the --slowest waterfall join)."""
     cfg = StreamConfig(seed=7, batch_rows=4, vocab_size=50)
     a, b = RequestStream(cfg), RequestStream(cfg)
     for i in (0, 1, 99):
@@ -349,7 +491,7 @@ def _selftest() -> int:
 
     calls = {"n": 0}
 
-    def fake_predict(features):
+    def fake_predict(features, **kwargs):
         calls["n"] += 1
         if calls["n"] % 5 == 0:
             raise _Shed()
@@ -372,7 +514,50 @@ def _selftest() -> int:
     if summary["latency"]["p99_ms"] < summary["latency"]["p50_ms"]:
         print("selftest FAILED: percentile ordering", file=sys.stderr)
         return 1
-    print("loadgen selftest OK")
+
+    # Request tracing: trace ids are pure in (seed, i); a traced run
+    # records every request; the --slowest table joins phase splits.
+    if trace_id_for(7, 3) != trace_id_for(7, 3) or \
+            trace_id_for(7, 3) == trace_id_for(8, 3) or \
+            trace_id_for(7, 3) == trace_id_for(7, 4):
+        print("selftest FAILED: trace ids not deterministic/distinct",
+              file=sys.stderr)
+        return 1
+    calls["n"] = 0
+    tracer = ClientTracer(seed=7)
+    run_closed_loop(fake_predict, a, num_requests=20, concurrency=2,
+                    trace=tracer)
+    records = tracer.records()
+    if len(records) != 20 or {r["trace_id"] for r in records} != {
+            trace_id_for(7, i) for i in range(20)}:
+        print(f"selftest FAILED: traced records {len(records)}",
+              file=sys.stderr)
+        return 1
+    outcomes = {r["outcome"] for r in records}
+    if not {"served", "shed"} <= outcomes:
+        print(f"selftest FAILED: traced outcomes {outcomes}",
+              file=sys.stderr)
+        return 1
+    top = slowest or 3
+    slow = tracer.slowest(top)
+    if len(slow) != top or \
+            slow[0]["latency_ms"] < slow[-1]["latency_ms"]:
+        print(f"selftest FAILED: slowest ordering {slow}", file=sys.stderr)
+        return 1
+    joined_events = [{
+        "ts": 0.0, "event": "request_trace",
+        "trace_id": slow[0]["trace_id"], "outcome": slow[0]["outcome"],
+        "sampled_by": "tail", "latency_ms": slow[0]["latency_ms"],
+        "phases": {"queue": 61.0, "batch": 2.0, "execute": 12.0,
+                   "respond": 2.0},
+        "dominant_phase": "queue",
+    }]
+    table = render_slowest(records, joined_events, top=top)
+    if slow[0]["trace_id"] not in table or "<- queue" not in table \
+            or "qqqq" not in table:
+        print(f"selftest FAILED: --slowest table\n{table}", file=sys.stderr)
+        return 1
+    print(f"loadgen selftest OK (--slowest {top} table joined)")
     return 0
 
 
@@ -401,10 +586,17 @@ def main(argv=None) -> int:
     parser.add_argument("--hot_share", type=float, default=0.8)
     parser.add_argument("--output", default="",
                         help="also write the JSON summary here")
+    parser.add_argument("--slowest", type=int, default=0,
+                        help="print trace ids + phase waterfalls of the N "
+                             "slowest requests (joined from the serve-dir "
+                             "journal's sampled request_trace events)")
+    parser.add_argument("--no_trace", action="store_true",
+                        help="do not attach trace ids / journal client "
+                             "spans (pre-tracing wire behaviour)")
     parser.add_argument("--selftest", action="store_true")
     args = parser.parse_args(argv)
     if args.selftest:
-        return _selftest()
+        return _selftest(args.slowest)
 
     addrs = list(args.addr)
     if args.serve_dir:
@@ -426,15 +618,37 @@ def main(argv=None) -> int:
         vocab_size=args.vocab_size, hot_fraction=args.hot_fraction,
         hot_share=args.hot_share,
     ))
+    tracer = None
+    if not args.no_trace:
+        tracer = ClientTracer(seed=args.seed, journal_dir=args.serve_dir)
     if args.mode == "open":
-        result = run_open_loop(predict, stream, args.qps, args.duration_s)
+        result = run_open_loop(predict, stream, args.qps, args.duration_s,
+                               trace=tracer)
     else:
         result = run_closed_loop(
-            predict, stream, args.requests, args.concurrency
+            predict, stream, args.requests, args.concurrency, trace=tracer
         )
     summary = {"targets": addrs, **result.summary()}
+    if tracer is not None and args.slowest:
+        summary["slowest"] = tracer.slowest(args.slowest)
     text = json.dumps(summary, indent=2)
     print(text)
+    if tracer is not None and args.slowest:
+        events: List[dict] = []
+        journal_path = os.path.join(args.serve_dir, "events.jsonl") \
+            if args.serve_dir else ""
+        if journal_path and os.path.exists(journal_path):
+            with open(journal_path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        events.append(rec)
+        print(render_slowest(tracer.records(), events, top=args.slowest),
+              file=sys.stderr)
     if args.output:
         with open(args.output, "w", encoding="utf-8") as f:
             f.write(text + "\n")

@@ -124,6 +124,13 @@ EVENT_REQUIRED_FIELDS = {
     # budget remaining, offending series).
     "slo_status": ("slo", "budget_remaining_ratio"),
     "slo_alert": ("slo", "state"),
+    # Request-level tracing exemplars (serving/ledger.py ExemplarSampler
+    # — docs/observability.md "Request tracing & exemplars").  Journaled
+    # only for sampled requests (deterministic head samples, over-SLO
+    # tails, and every non-served outcome), so exemplar volume is
+    # O(sampled), never O(requests); the trace id is journal-only per
+    # the cardinality rule.
+    "request_trace": ("trace_id", "outcome", "sampled_by"),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -188,6 +195,11 @@ EVENT_OPTIONAL_FIELDS = {
     "span": (
         "trace_id", "span_id", "parent_span_id", "start_ts", "proc",
         "task_id", "worker_id", "error", "steps",
+        # Serving request spans (rpc.predict / serve.queue /
+        # serve.execute / serve.respond) and the shared serve.batch span
+        # every member request links to via `batch_span_id`.
+        "rows", "outcome", "batch_rows", "bucket", "generation",
+        "requests", "batch_span_id", "addr",
     ),
     "phase_transition": ("cause",),
     "rescale_cost": (
@@ -231,6 +243,11 @@ EVENT_OPTIONAL_FIELDS = {
         "generation", "step", "inflight", "queue_depth", "qps",
         "p50_ms", "p99_ms", "availability_ratio", "served", "dropped",
         "shed", "errors", "model_event_time",
+        # Per-phase p99 split (queue/batch/execute/respond — the
+        # obs.top --serving QU/BA/EX/RE columns) and the slowest recent
+        # exemplar ({trace_id, latency_ms, dominant_phase}).
+        "queue_p99_ms", "batch_p99_ms", "execute_p99_ms",
+        "respond_p99_ms", "exemplar",
     ),
     "serving_replica_start": ("model_dir", "generation"),
     "serving_fleet_start": ("model_dir", "serve_dir"),
@@ -245,6 +262,14 @@ EVENT_OPTIONAL_FIELDS = {
     "slo_alert": (
         "grade", "burn_rates", "budget_remaining_ratio", "offending",
         "windows", "origin", "objective",
+        # Up-to-K exemplar trace ids from the serving ExemplarSampler:
+        # the offending-REQUEST evidence beside the offending-series
+        # string (resolvable in the assembled obs.trace output).
+        "exemplars",
+    ),
+    "request_trace": (
+        "latency_ms", "phases", "dominant_phase", "rows", "replica_id",
+        "generation", "bucket",
     ),
     "checkpoint_saved": ("step", "kind", "n_processes", "event_time"),
     "checkpoint_restored": ("step", "kind"),
@@ -488,6 +513,39 @@ def _selftest() -> int:
                         "slow_short": 0.1, "slow_long": 1.1},
          "budget_remaining_ratio": 0.11, "offending": "",
          "origin": "replica_0"},
+        # Request tracing & exemplars (PR 19): a latency slo_alert
+        # carrying exemplar trace ids, the shared serve.batch span, a
+        # member request's phase span linking to it, and the sampler's
+        # request_trace records (a tail exemplar + a minimal shed one).
+        {"ts": 7.38, "event": "slo_alert", "slo": "serving_latency",
+         "state": "fire", "grade": "page",
+         "burn_rates": {"fast_short": 20.1, "fast_long": 15.0,
+                        "slow_short": 15.0, "slow_long": 2.8},
+         "budget_remaining_ratio": 0.4,
+         "offending": "elasticdl_serving_latency_p99_ms",
+         "origin": "replica_1", "exemplars": ["lg7-00000102"]},
+        {"ts": 7.4, "event": "span", "name": "serve.batch",
+         "duration_s": 0.004, "start_ts": 7.39, "span_id": "s-b-1",
+         "proc": "replica_0", "batch_rows": 24, "bucket": 32,
+         "generation": 2, "requests": 3},
+        {"ts": 7.42, "event": "span", "name": "serve.execute",
+         "duration_s": 0.003, "start_ts": 7.391, "span_id": "s-e-1",
+         "parent_span_id": "s-b-1", "trace_id": "lg7-00000102",
+         "proc": "replica_0", "rows": 8, "batch_span_id": "s-b-1"},
+        {"ts": 7.44, "event": "request_trace", "trace_id": "lg7-00000102",
+         "outcome": "served", "sampled_by": "tail", "latency_ms": 81.2,
+         "phases": {"queue": 63.1, "batch": 0.8, "execute": 17.1,
+                    "respond": 0.2},
+         "dominant_phase": "queue", "rows": 8, "replica_id": 0,
+         "generation": 2, "bucket": 32},
+        {"ts": 7.46, "event": "request_trace", "trace_id": "lg7-00000140",
+         "outcome": "shed", "sampled_by": "outcome"},
+        {"ts": 7.48, "event": "serving_telemetry", "replica_id": 0,
+         "generation": 2, "qps": 410.0, "p99_ms": 81.2,
+         "queue_p99_ms": 63.1, "batch_p99_ms": 0.9,
+         "execute_p99_ms": 17.4, "respond_p99_ms": 0.3,
+         "exemplar": {"trace_id": "lg7-00000102", "latency_ms": 81.2,
+                      "dominant_phase": "queue"}},
         {"ts": 7.3, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
@@ -512,6 +570,10 @@ def _selftest() -> int:
         '{"ts": 1.4995, "event": "slo_status", "slo": "goodput"}',  # no budget
         '{"ts": 1.4996, "event": "slo_alert", "slo": "goodput"}',   # no state
         '{"ts": 1.4997, "event": "slo_alert", "state": "fire"}',    # no slo
+        '{"ts": 1.4998, "event": "request_trace", "trace_id": "t",'
+        ' "outcome": "served"}',                        # no sampled_by
+        '{"ts": 1.4999, "event": "request_trace", "outcome": "shed",'
+        ' "sampled_by": "outcome"}',                    # no trace_id
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

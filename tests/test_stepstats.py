@@ -622,7 +622,15 @@ def test_new_call_sites_pass_purity_and_cardinality_rules():
             "elasticdl_tpu/master/servicer.py",
             "elasticdl_tpu/master/task_manager.py",
             "elasticdl_tpu/parallel/elastic.py",
+            "elasticdl_tpu/serving/ledger.py",
+            "elasticdl_tpu/serving/frontend.py",
+            "elasticdl_tpu/serving/batcher.py",
+            "elasticdl_tpu/serving/replica_main.py",
+            "elasticdl_tpu/obs/slo.py",
+            "elasticdl_tpu/obs/report.py",
+            "elasticdl_tpu/obs/top.py",
             "scripts/bench_regress.py",
+            "scripts/loadgen.py",
         )
     ]
     violations = run_checks(
