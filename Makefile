@@ -6,7 +6,8 @@
 .PHONY: all proto native test test-fast test-sparse sparse-gates \
         test-compile compile-gates test-chaos test-obs test-serving \
         serving-gates test-pipeline test-stream stream-gates test-slo \
-        slo-gates e2e bench bench-regress wheel clean lint \
+        slo-gates quality-gates test-quality e2e bench bench-regress \
+        wheel clean lint \
         check-invariants
 
 all: proto native test
@@ -62,8 +63,26 @@ lint:
 # test-sparse / test-compile targets would run them twice per tier-1
 # pass.
 test-fast: lint sparse-gates compile-gates serving-gates stream-gates \
-           slo-gates
+           slo-gates quality-gates
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# Script gate of the model-quality plane, shared by test-quality and
+# test-fast: the label-join ledger / drift-sketch / canary-gate
+# selftest (online==offline AUC, fault-site degradation, gate
+# held/passed/forced verdicts), plus the loadgen delayed-label replay
+# half (pure label rule, broadcast join accounting, outage tolerance).
+quality-gates:
+	JAX_PLATFORMS=cpu python -m elasticdl_tpu.obs.quality --selftest
+	JAX_PLATFORMS=cpu python scripts/loadgen.py --selftest --labels
+
+# Standalone model-quality gate (docs/observability.md "Model
+# quality"): ledger/sketch/gate units, the graceful-degradation pins
+# (pre-quality journals render byte-identical top/report frames), and
+# — without `-m 'not slow'` — the poisoned-delta canary acceptance e2e
+# (label-flipped shard HELD with journaled evidence + quality SLO
+# alert, healthy delta passes, zero dropped requests).
+test-quality: quality-gates
+	JAX_PLATFORMS=cpu python -m pytest tests/test_quality.py -q
 
 # Script gate of the continuous train->serve loop, shared by
 # test-stream and test-fast: the freshness SLO tracker's deterministic

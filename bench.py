@@ -308,6 +308,47 @@ def bench_deepfm_fused_multichip():
     )
 
 
+def bench_deepfm_online_auc_window(
+    rows: int = 256, batches: int = 4, rounds: int = 5, vocab: int = 1000,
+):
+    """Windowed online AUC through the REAL label-join path: synthetic
+    click batches scored by a deterministic fixed-separation scorer,
+    predictions noted into a QualityLedger keyed by trace id, delayed
+    labels (the training stream's pure click_label_rule) joined against
+    them, and the windowed rank-based AUC read off the ledger snapshot.
+    The row anchors the ledger's window math in the bench artifact —
+    join bookkeeping plus online==offline AUC — NOT model quality, so
+    it stays tracked:false (scripts/bench_regress.py UNTRACKED)."""
+    from elasticdl_tpu.data.stream import (
+        click_label_rule,
+        synthetic_click_batch,
+    )
+    from elasticdl_tpu.obs.quality import QualityLedger
+
+    values = []
+    for r in range(rounds):
+        ledger = QualityLedger(
+            window_size=rows * batches, join_window_s=60.0
+        )
+        rng = np.random.RandomState(17 + r)
+        for b in range(batches):
+            lo = r * 100_000 + b * rows
+            feats = synthetic_click_batch(lo, lo + rows, vocab)
+            labels = click_label_rule(feats)
+            preds = np.clip(
+                0.5 + 0.25 * (2.0 * labels - 1.0)
+                + 0.3 * rng.randn(rows),
+                1e-3, 1.0 - 1e-3,
+            ).astype(np.float32)
+            trace_id = f"bench-{r}-{b}"
+            ledger.note_prediction(trace_id, preds, now=float(b))
+            ledger.note_label(trace_id, labels, now=float(b) + 0.5)
+        snapshot = ledger.snapshot()
+        assert snapshot["joined"] == rows * batches, snapshot
+        values.append(float(snapshot["auc"]))
+    return float(np.mean(values)), float(np.max(values) - np.min(values))
+
+
 def bench_deepfm_serve(
     vocab: int = 100_000,
     request_rows: int = 8,
@@ -1315,6 +1356,20 @@ def main():
             "lower-is-better: the regression gate's ratio direction "
             "assumes higher-is-better, so this row reports but must "
             "never gate (scripts/bench_regress.py)"
+        ),
+    )
+    auc_value, auc_spread = bench_deepfm_online_auc_window()
+    _emit(
+        "deepfm_online_auc_window",
+        auc_value,
+        "auc",
+        auc_spread,
+        tracked=False,
+        untracked_reason=(
+            "anchors the label-join ledger's windowed-AUC math on a "
+            "synthetic fixed-separation scorer, not model quality; "
+            "flips meaningful only when a trained chip model feeds "
+            "the ledger (obs/quality.py)"
         ),
     )
     # The north-star headline prints LAST (the driver parses the final

@@ -327,6 +327,18 @@ def add_cluster_arguments(parser: argparse.ArgumentParser):
         help="Seconds between SLO-plane sample+evaluate ticks.",
     )
     parser.add_argument(
+        "--quality_drift_bins", type=non_neg_int, default=0,
+        help="Hash buckets of the train-side feature-id sketch "
+        "(obs/quality.py): each worker sketches every train batch into "
+        "a process-local DriftMonitor for train-serve skew comparison; "
+        "0 disables the hook (the default — no per-step cost).",
+    )
+    parser.add_argument(
+        "--quality_drift_threshold", type=float, default=0.25,
+        help="Train-serve sketch divergence (total variation) that "
+        "journals a quality_drift breach edge.",
+    )
+    parser.add_argument(
         "--worker_liveness_timeout_s", type=non_neg_int, default=60,
         help="Kill+relaunch a worker whose heartbeat is silent this long "
         "(0 disables hung-worker detection)",

@@ -34,6 +34,21 @@ Injection sites wired into the framework:
                    every serving-side delta apply (kind: error[=msg] —
                    the apply fails and rolls back to the previous
                    generation)
+    stream.labels  every delayed-label range fetch
+                   (data/stream.feedback_labels; kinds:
+                   truncate — label-feed outage, the range returns no
+                   labels; error — poisoned feed, every label flipped:
+                   the canary-gate chaos scenario)
+    quality.label_join
+                   every label delivery into the quality ledger
+                   (obs/quality.py; kinds: error — the label is
+                   dropped; truncate — delivered twice, the
+                   at-least-once-feed duplicate)
+    quality.shadow_eval
+                   every canary-gate shadow evaluation (kind:
+                   error[=msg] — the evaluation blows up; the gate
+                   degrades to quality-unknown instead of crashing
+                   the delta watcher)
 
 Spec grammar (comma/semicolon separated, via `ELASTICDL_FAULTS` or
 `install()`):

@@ -48,6 +48,7 @@ class SyntheticClickStream:
         self,
         schedule: Sequence[Tuple[float, float]],
         name: str = "stream",
+        label_delay_s: float = 0.0,
     ):
         if not schedule:
             raise ValueError("stream schedule needs at least one phase")
@@ -56,10 +57,13 @@ class SyntheticClickStream:
                 raise ValueError(f"bad schedule phase ({duration}, {rate})")
         if schedule[-1][1] <= 0:
             raise ValueError("final schedule phase must have rate > 0")
+        if label_delay_s < 0:
+            raise ValueError("label_delay_s must be >= 0")
         self.name = name
         self._schedule: List[Tuple[float, float]] = [
             (float(d), float(r)) for d, r in schedule
         ]
+        self._label_delay_s = float(label_delay_s)
         self._elapsed = 0.0
         self._stall_s = 0.0
         self._closed = False
@@ -123,6 +127,38 @@ class SyntheticClickStream:
         stall so far.  Monotone in elapsed time."""
         return self.records_until(self._elapsed - self._stall_s)
 
+    @property
+    def label_delay_s(self) -> float:
+        return self._label_delay_s
+
+    def labels_available(self) -> int:
+        """Records whose delayed feedback label has ARRIVED by now: the
+        label for record `o` lands `label_delay_s` of virtual time after
+        the record itself (clicks are attributed late), and a stalled
+        source delays the labels with the records.  Monotone, and always
+        <= `available()` — the label watermark trails the record
+        watermark by construction."""
+        return self.records_until(
+            self._elapsed - self._stall_s - self._label_delay_s
+        )
+
+    def labels_for(
+        self,
+        lo: int,
+        hi: int,
+        vocab_size: int,
+        fields: Sequence[str] = ("user", "item"),
+    ) -> Optional[np.ndarray]:
+        """Delayed-feedback labels for offsets [lo, hi): the same
+        offset-pure generator family as `synthetic_click_batch`, routed
+        through the `stream.labels` fault site (`feedback_labels`) so a
+        chaos run can poison (flip) or black out the label feed.  The
+        caller owns the watermark discipline — only ask for ranges below
+        `labels_available()`."""
+        return feedback_labels(
+            synthetic_click_batch(lo, hi, vocab_size, fields)
+        )
+
     def event_time(self, offset: int) -> float:
         """Event time (virtual seconds since stream start) of record
         `offset` — the schedule's inverse, stall-independent."""
@@ -146,6 +182,7 @@ class SyntheticClickStream:
         return {
             "name": self.name,
             "schedule": [list(p) for p in self._schedule],
+            "label_delay_s": self._label_delay_s,
             "elapsed": self._elapsed,
             "stall_s": self._stall_s,
             "closed": self._closed,
@@ -154,7 +191,9 @@ class SyntheticClickStream:
     @classmethod
     def from_json(cls, obj: dict) -> "SyntheticClickStream":
         stream = cls(
-            [tuple(p) for p in obj["schedule"]], name=obj.get("name", "stream")
+            [tuple(p) for p in obj["schedule"]],
+            name=obj.get("name", "stream"),
+            label_delay_s=float(obj.get("label_delay_s", 0.0)),
         )
         stream._elapsed = float(obj.get("elapsed", 0.0))
         stream._stall_s = float(obj.get("stall_s", 0.0))
@@ -179,6 +218,51 @@ def synthetic_click_batch(
         )
         for i, name in enumerate(fields)
     }
+
+
+def click_label_rule(features: dict) -> np.ndarray:
+    """Deterministic ground-truth click label per row: a pure function
+    of the integer feature ids, so it is learnable from the embeddings,
+    replayable offline, and IDENTICAL wherever it is evaluated — the
+    stream's delayed-feedback channel, `scripts/loadgen.py --labels`,
+    and an offline AUC audit of the same joined set all agree
+    element-wise.  ~31% positive rate (the `< 30 of 97` residue)."""
+    acc = None
+    for i, name in enumerate(sorted(features)):
+        arr = np.asarray(features[name])
+        if not np.issubdtype(arr.dtype, np.integer):
+            continue
+        ids = arr.astype(np.int64)
+        if ids.ndim == 1:
+            ids = ids[:, None]
+        weights = 13 + 7 * np.arange(ids.shape[-1], dtype=np.int64)
+        contrib = (ids * weights).sum(axis=-1) * (1 + i)
+        acc = contrib if acc is None else acc + contrib
+    if acc is None:
+        raise ValueError(
+            "click_label_rule needs at least one integer feature array"
+        )
+    return ((acc % 97) < 30).astype(np.float32)
+
+
+def feedback_labels(features: dict) -> Optional[np.ndarray]:
+    """The label FEED: `click_label_rule` routed through the
+    ``stream.labels`` fault site.  kind ``truncate`` -> outage (None:
+    no labels arrive for this range this poll); kind ``error`` ->
+    poisoned feed (flipped labels — the canary-gate chaos scenario, a
+    label-flipped shard entering training)."""
+    spec = faults.fire("stream.labels")
+    if spec is not None and spec.kind == "truncate":
+        logger.warning("FAULT INJECTION: label feed outage (range withheld)")
+        return None
+    labels = click_label_rule(features)
+    if spec is not None and spec.kind == "error":
+        logger.warning(
+            "FAULT INJECTION: label feed poisoned (labels flipped, %s)",
+            spec.arg or "flip",
+        )
+        labels = (1.0 - labels).astype(labels.dtype)
+    return labels
 
 
 def iter_stream_batches(

@@ -219,6 +219,9 @@ def summarize(events: List[dict]) -> dict:
     freshness = _freshness_summary(events)
     if freshness:
         summary["freshness"] = freshness
+    quality = _quality_summary(events)
+    if quality:
+        summary["quality"] = quality
     slo = _slo_summary(events, segments)
     if slo:
         summary["slo"] = slo
@@ -354,6 +357,93 @@ def _num(value) -> Optional[float]:
     if isinstance(value, (int, float)) and not isinstance(value, bool):
         return float(value)
     return None
+
+
+#: Rows in the per-section quality timelines (AUC windows, gate ledger).
+TOP_QUALITY_ROWS = 8
+
+
+def _quality_summary(events: List[dict]) -> Optional[dict]:
+    """Fold the model-quality plane's events (``quality_window`` online
+    label-join rollups, ``quality_drift`` train-serve divergence edges,
+    ``quality_gate`` canary verdicts) into one section.  Returns None
+    when the journal predates the quality plane, so old journals render
+    no section at all."""
+    windows = [e for e in events if e.get("event") == "quality_window"]
+    drifts = [e for e in events if e.get("event") == "quality_drift"]
+    gates = [e for e in events if e.get("event") == "quality_gate"]
+    if not (windows or drifts or gates):
+        return None
+    section: dict = {
+        "window_updates": len(windows),
+        "gate_decisions": len(gates),
+        "drift_events": len(drifts),
+    }
+    if windows:
+        # Latest rollup per origin: the "where quality stands now" row.
+        latest: Dict[str, dict] = {}
+        for event in windows:
+            latest[str(event.get("origin") or "")] = event
+        section["latest"] = [
+            {
+                key: latest[origin].get(key)
+                for key in (
+                    "origin", "joined", "window", "pending", "expired",
+                    "orphans", "auc", "logloss", "calibration_error",
+                    "prediction_mean", "label_mean",
+                )
+            }
+            for origin in sorted(latest)
+        ]
+        timeline = [
+            {
+                "ts": _num(event.get("ts")),
+                "origin": str(event.get("origin") or ""),
+                "auc": _num(event.get("auc")),
+                "logloss": _num(event.get("logloss")),
+                "joined": event.get("joined"),
+            }
+            for event in windows
+            if _num(event.get("auc")) is not None
+        ]
+        if timeline:
+            section["auc_timeline"] = timeline[-TOP_QUALITY_ROWS:]
+    if gates:
+        section["gates"] = [
+            {
+                key: event.get(key)
+                for key in (
+                    "ts", "outcome", "step", "origin", "reason", "rows",
+                    "quality", "baseline_logloss", "candidate_logloss",
+                    "baseline_auc", "candidate_auc", "delta_dir",
+                )
+            }
+            for event in gates
+        ]
+        section["holds"] = sum(
+            1 for e in gates if e.get("outcome") == "held"
+        )
+        section["forced"] = sum(
+            1 for e in gates if e.get("outcome") == "forced"
+        )
+    if drifts:
+        section["drift_breaches"] = sum(
+            1 for e in drifts if e.get("state") == "breach"
+        )
+        final_state: Dict[str, str] = {}
+        for event in drifts:
+            final_state[str(event.get("origin") or "")] = str(
+                event.get("state")
+            )
+        section["drift_final_state"] = final_state
+        divergences = [
+            _num(e.get("divergence"))
+            for e in drifts
+            if _num(e.get("divergence")) is not None
+        ]
+        if divergences:
+            section["max_divergence"] = round(max(divergences), 6)
+    return section
 
 
 def _slo_summary(
@@ -810,6 +900,119 @@ def render_report(summary: dict, max_segments: int = 80) -> str:
                 )
         elif freshness["breaches"] == 0:
             lines.append("  freshness SLO: not configured")
+    quality = summary.get("quality")
+    if quality:
+        lines.append("")
+        lines.append("model quality (online label-join evaluation):")
+        for row in quality.get("latest", ()):
+            where = f"@{row['origin']}" if row.get("origin") else ""
+            bits = [
+                f"  window{where}: joined {row.get('joined')}"
+                f" ({row.get('window')} in window,"
+                f" {row.get('pending')} pending,"
+                f" {row.get('expired')} expired,"
+                f" {row.get('orphans')} orphaned)"
+            ]
+            auc = row.get("auc")
+            if isinstance(auc, (int, float)):
+                bits.append(f"auc {float(auc):.3f}")
+            logloss = row.get("logloss")
+            if isinstance(logloss, (int, float)):
+                bits.append(f"logloss {float(logloss):.3f}")
+            cal = row.get("calibration_error")
+            if isinstance(cal, (int, float)):
+                bits.append(f"cal err {float(cal):.3f}")
+            mean = row.get("prediction_mean")
+            label_mean = row.get("label_mean")
+            if isinstance(mean, (int, float)) and isinstance(
+                label_mean, (int, float)
+            ):
+                bits.append(
+                    f"pred mean {float(mean):.3f} vs label "
+                    f"{float(label_mean):.3f}"
+                )
+            lines.append(";  ".join(bits))
+        timeline = quality.get("auc_timeline")
+        if timeline:
+            t0 = summary.get("start_ts", 0.0)
+            lines.append("  windowed AUC timeline:")
+            for point in timeline:
+                ts = point.get("ts")
+                offset = (
+                    f"+{ts - t0:9.2f}s" if isinstance(ts, (int, float))
+                    else f"{'?':>10}"
+                )
+                lines.append(
+                    f"    {offset}  auc {point['auc']:.3f}"
+                    + (
+                        f"  logloss {point['logloss']:.3f}"
+                        if point.get("logloss") is not None
+                        else ""
+                    )
+                    + f"  (joined {point.get('joined')}"
+                    + (
+                        f" @{point['origin']})" if point.get("origin")
+                        else ")"
+                    )
+                )
+        if quality.get("drift_events"):
+            states = ", ".join(
+                f"{origin or '(unlabeled)'}: {state}"
+                for origin, state in sorted(
+                    quality.get("drift_final_state", {}).items()
+                )
+            )
+            lines.append(
+                f"  train-serve drift: "
+                f"{quality.get('drift_breaches', 0)} breach(es)"
+                + (
+                    f", max divergence {quality['max_divergence']:.3f}"
+                    if quality.get("max_divergence") is not None
+                    else ""
+                )
+                + (f"  [{states}]" if states else "")
+            )
+        gates = quality.get("gates")
+        if gates:
+            lines.append(
+                f"  canary gate: {quality['gate_decisions']} decision(s), "
+                f"{quality.get('holds', 0)} held, "
+                f"{quality.get('forced', 0)} forced"
+            )
+            t0 = summary.get("start_ts", 0.0)
+            for gate in gates[-TOP_QUALITY_ROWS:]:
+                ts = gate.get("ts")
+                offset = (
+                    f"+{ts - t0:9.2f}s" if isinstance(ts, (int, float))
+                    else f"{'?':>10}"
+                )
+                extra = ""
+                if gate.get("reason"):
+                    extra += f"  ({gate['reason']})"
+                base = gate.get("baseline_logloss")
+                cand = gate.get("candidate_logloss")
+                if isinstance(base, (int, float)) and isinstance(
+                    cand, (int, float)
+                ):
+                    extra += (
+                        f"  logloss {float(base):.3f} -> {float(cand):.3f}"
+                    )
+                base_auc = gate.get("baseline_auc")
+                cand_auc = gate.get("candidate_auc")
+                if isinstance(base_auc, (int, float)) and isinstance(
+                    cand_auc, (int, float)
+                ):
+                    extra += (
+                        f"  auc {float(base_auc):.3f} -> "
+                        f"{float(cand_auc):.3f}"
+                    )
+                where = f"@{gate['origin']}" if gate.get("origin") else ""
+                lines.append(
+                    f"    {offset}  {str(gate.get('outcome')).upper():<6} "
+                    f"step {gate.get('step')}{where}"
+                    f" [{gate.get('quality') or 'known'}"
+                    f", {gate.get('rows') or 0} rows]{extra}"
+                )
     slo = summary.get("slo")
     if slo:
         lines.append("")
@@ -1054,6 +1257,45 @@ def selftest(path: str) -> int:
                     f"{breach['cleared_ts']} before firing at "
                     f"{breach['fired_ts']}"
                 )
+    quality = summary.get("quality")
+    if quality:
+        for row in quality.get("latest", ()):
+            auc = row.get("auc")
+            if auc is not None and not (0.0 <= auc <= 1.0):
+                problems.append(
+                    f"quality window {row.get('origin')}: auc {auc} "
+                    "not in [0,1]"
+                )
+            logloss = row.get("logloss")
+            if logloss is not None and logloss < 0:
+                problems.append(
+                    f"quality window {row.get('origin')}: negative "
+                    f"logloss {logloss}"
+                )
+            cal = row.get("calibration_error")
+            if cal is not None and not (0.0 <= cal <= 1.0):
+                problems.append(
+                    f"quality window {row.get('origin')}: calibration "
+                    f"error {cal} not in [0,1]"
+                )
+        for gate in quality.get("gates", ()):
+            if gate.get("outcome") not in ("passed", "held", "forced"):
+                problems.append(
+                    f"quality gate outcome {gate.get('outcome')!r} "
+                    "unknown"
+                )
+            if gate.get("outcome") == "held" and not gate.get("reason"):
+                problems.append(
+                    f"held quality gate at step {gate.get('step')} "
+                    "carries no reason"
+                )
+        if quality.get("max_divergence") is not None and not (
+            0.0 <= quality["max_divergence"] <= 1.0
+        ):
+            problems.append(
+                f"quality drift divergence {quality['max_divergence']} "
+                "not in [0,1] (total variation)"
+            )
     tail = summary.get("tail_latency")
     if tail:
         fractions = tail.get("phase_fractions")

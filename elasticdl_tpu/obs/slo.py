@@ -185,6 +185,27 @@ def freshness_slo(lag_slo_s: float, objective: float = 0.99,
     )
 
 
+def quality_slo(max_logloss: float, objective: float = 0.95,
+                compliance_window_s: float = 3600.0,
+                min_window_s: float = 5.0) -> SLOSpec:
+    """Windowed online logloss (the quality ledger's
+    `elasticdl_quality_logloss` gauge, obs/quality.py) must stay under
+    `max_logloss` — the model-quality page.  The gauge reads 0.0 while
+    no labels have joined, so quality-unknown never burns budget; a
+    poisoned model that DOES get labeled burns fast and the alert's
+    advisory evidence reaches the policy engine like every other SLO."""
+    return SLOSpec(
+        name="model_quality",
+        kind="threshold",
+        objective=objective,
+        compliance_window_s=compliance_window_s,
+        value_metric="elasticdl_quality_logloss",
+        threshold=float(max_logloss),
+        bad_when="above",
+        min_window_s=min_window_s,
+    )
+
+
 def goodput_slo(ratio: float, objective: float = 0.95,
                 compliance_window_s: float = 3600.0,
                 min_window_s: float = 5.0) -> SLOSpec:

@@ -82,6 +82,17 @@ _SERVING_PHASE_COLUMNS = (
     ("respond_p99_ms", "RE(ms)"),
 )
 
+#: Model-quality columns (label-join evaluation plane): row field ->
+#: column header.  Fed from `quality_window` / `quality_drift` journal
+#: events folded per replica origin — rendered only when the journal
+#: carries them, so pre-quality journals get the pre-quality frame
+#: byte-for-byte.
+_SERVING_QUALITY_COLUMNS = (
+    ("quality_auc", "AUC"),
+    ("quality_cal", "CAL"),
+    ("quality_drift", "DRIFT"),
+)
+
 
 def fetch_text(url: str, timeout_s: float = 5.0) -> str:
     with urllib.request.urlopen(url, timeout=timeout_s) as response:
@@ -350,6 +361,50 @@ def freshness_note(events: List[dict]) -> str:
     return f"freshness: ok (last clear at lag={lag:.1f}s, slo={slo:.1f}s)"
 
 
+def quality_note(events: List[dict]) -> str:
+    """The model-quality state line for the serving frame — "" against
+    journals from fleets predating the quality plane (no
+    `quality_window` events; degrade, never raise)."""
+    last = None
+    gate = None
+    for event in events:
+        kind = event.get("event")
+        if kind == "quality_window":
+            last = event
+        elif kind == "quality_gate":
+            gate = event
+    if not isinstance(last, dict):
+        return ""
+    try:
+        joined = int(last.get("joined", 0))
+        pending = int(last.get("pending", 0))
+    except (TypeError, ValueError):
+        return ""
+    bits = [f"quality: joined={joined} pending={pending}"]
+    auc = last.get("auc")
+    if isinstance(auc, (int, float)):
+        bits.append(f"auc={float(auc):.3f}")
+    logloss = last.get("logloss")
+    if isinstance(logloss, (int, float)):
+        bits.append(f"logloss={float(logloss):.3f}")
+    if isinstance(gate, dict) and gate.get("outcome") in ("held", "forced"):
+        bits.append(
+            f"gate={gate['outcome'].upper()} at step {gate.get('step')}"
+        )
+    return " ".join(bits)
+
+
+def _origin_replica_id(origin) -> Optional[int]:
+    """`replica_<id>` quality origins -> the serving_telemetry row key;
+    None for anything else (worker origins, free-form strings)."""
+    if isinstance(origin, str) and origin.startswith("replica_"):
+        try:
+            return int(origin[len("replica_"):])
+        except ValueError:
+            return None
+    return None
+
+
 def serving_rows(
     events: List[dict], now: Optional[float] = None
 ) -> List[dict]:
@@ -360,6 +415,8 @@ def serving_rows(
     now = time.time() if now is None else now
     latest: Dict[int, dict] = {}
     watermark_et = None
+    quality_latest: Dict[int, dict] = {}
+    drift_latest: Dict[int, dict] = {}
     for event in events:
         kind = event.get("event")
         if kind == "stream_watermark":
@@ -368,6 +425,14 @@ def serving_rows(
             et = event.get("event_time")
             if isinstance(et, (int, float)):
                 watermark_et = float(et)
+            continue
+        if kind in ("quality_window", "quality_drift"):
+            # Model-quality plane: the latest windowed eval / drift
+            # state per replica, joined onto the telemetry row below.
+            rid = _origin_replica_id(event.get("origin"))
+            if rid is not None:
+                (quality_latest if kind == "quality_window"
+                 else drift_latest)[rid] = event
             continue
         if kind != "serving_telemetry":
             continue
@@ -408,6 +473,22 @@ def serving_rows(
         )
         for field, _label in _SERVING_PHASE_COLUMNS:
             rows[-1][field] = event.get(field)
+        quality = quality_latest.get(rid)
+        if isinstance(quality, dict):
+            auc = quality.get("auc")
+            cal = quality.get("calibration_error")
+            rows[-1]["quality_auc"] = (
+                float(auc) if isinstance(auc, (int, float)) else None
+            )
+            rows[-1]["quality_cal"] = (
+                float(cal) if isinstance(cal, (int, float)) else None
+            )
+        drift = drift_latest.get(rid)
+        if isinstance(drift, dict):
+            div = drift.get("divergence")
+            if isinstance(div, (int, float)):
+                rows[-1]["quality_drift"] = float(div)
+                rows[-1]["quality_drift_state"] = drift.get("state")
         exemplar = event.get("exemplar")
         if isinstance(exemplar, dict):
             rows[-1]["exemplar"] = exemplar
@@ -440,6 +521,17 @@ def render_serving(
         columns = columns + tuple(
             label for _field, label in _SERVING_PHASE_COLUMNS
         )
+    # Likewise the quality columns: only when some replica's journal
+    # carries a joined-label evaluation window or a drift sketch.
+    has_quality = any(
+        row.get(field) is not None
+        for row in rows
+        for field, _label in _SERVING_QUALITY_COLUMNS
+    )
+    if has_quality:
+        columns = columns + tuple(
+            label for _field, label in _SERVING_QUALITY_COLUMNS
+        )
     table: List[Tuple[str, ...]] = [columns]
     for row in rows:
         cells = (
@@ -462,6 +554,12 @@ def render_serving(
             cells = cells + tuple(
                 _fixed_ms(row.get(field))
                 for field, _label in _SERVING_PHASE_COLUMNS
+            )
+        if has_quality:
+            cells = cells + (
+                _fixed3(row.get("quality_auc")),
+                _fixed3(row.get("quality_cal")),
+                _drift_cell(row),
             )
         table.append(cells)
     widths = [
@@ -504,6 +602,22 @@ def _fixed_ms(value) -> str:
     if value is None:
         return "-"
     return f"{float(value):.1f}"
+
+
+def _fixed3(value) -> str:
+    """Three-decimal quality ratio (AUC, calibration error)."""
+    if value is None:
+        return "-"
+    return f"{float(value):.3f}"
+
+
+def _drift_cell(row: dict) -> str:
+    """Train-serve divergence cell; `!` flags an un-cleared breach."""
+    value = row.get("quality_drift")
+    if value is None:
+        return "-"
+    mark = "!" if row.get("quality_drift_state") == "breach" else ""
+    return f"{float(value):.2f}{mark}"
 
 
 def _ms(seconds) -> str:
@@ -608,6 +722,9 @@ def snapshot_frame(addr: str, tail: int = 256, serving: bool = False) -> str:
         fresh = freshness_note(events)
         if fresh:
             notes.append(fresh)
+        quality = quality_note(events)
+        if quality:
+            notes.append(quality)
         slo_line = slo_header(slo_payload)
         if slo_line:
             notes.append(slo_line)

@@ -127,12 +127,14 @@ class MicroBatcher:
         config: BatcherConfig = BatcherConfig(),
         on_request: Optional[Callable[[Dict[str, float], str, int], None]] = None,
         on_shed: Optional[Callable[[int], None]] = None,
+        on_batch: Optional[Callable[[Dict[str, np.ndarray]], None]] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self._execute_fn = execute_fn
         self._config = config
         self._on_request = on_request
         self._on_shed = on_shed
+        self._on_batch = on_batch
         self._clock = clock
         self._buckets = bucket_sizes(config.max_batch_size)
         self._lock = make_lock("MicroBatcher._lock")
@@ -324,6 +326,15 @@ class MicroBatcher:
             )
             for key in live[0].features
         }
+        if self._on_batch is not None:
+            # Serve-side quality sketch hook: sees the REAL (unpadded)
+            # stacked features — pad rows would skew the id-frequency
+            # sketch toward id 0.  Host-side numpy only; its failure
+            # must never fail the batch.
+            try:
+                self._on_batch(stacked)
+            except Exception:
+                logger.exception("on_batch hook failed (ignored)")
         wall_batch = time.time()
         padded, _ = pad_and_stage(stacked, rows, self._buckets)
         bucket = bucket_for(rows, self._buckets)

@@ -22,6 +22,12 @@ Methods (service ``elasticdl_tpu.Predict``):
 - ``stats``: JSON replica + availability-ledger snapshot (the loadgen
   and obs.top's serving mode read the same numbers from the exporter;
   this RPC is for point debugging).
+- ``labels``: delayed feedback labels for earlier predict calls — an
+  npz dict keyed by TRACE ID (the join key the quality ledger holds
+  sampled predictions under), value = that request's label array.
+  Replies JSON ``{"received", "joined", "enabled"}``; a replica
+  without a quality ledger accepts and ignores (``enabled: false``),
+  so label feeds are wire-compatible with pre-quality replicas.
 """
 
 from __future__ import annotations
@@ -44,7 +50,7 @@ from elasticdl_tpu.serving.batcher import MicroBatcher, QueueFullError
 logger = get_logger("serving.frontend")
 
 _SERVICE_NAME = "elasticdl_tpu.Predict"
-_METHODS = ("predict", "reload", "stats")
+_METHODS = ("predict", "reload", "stats", "labels")
 
 #: Server-side floor under the client deadline: leave headroom for the
 #: response to travel back instead of computing a result nobody waits for.
@@ -100,10 +106,13 @@ class PredictServicer:
     (serving/ledger.py), which journals the span set only for sampled
     requests — O(sampled), never O(requests)."""
 
-    def __init__(self, replica, batcher: MicroBatcher, sampler=None):
+    def __init__(self, replica, batcher: MicroBatcher, sampler=None,
+                 quality=None, quality_clock=time.monotonic):
         self._replica = replica
         self._batcher = batcher
         self._sampler = sampler
+        self._quality = quality
+        self._quality_clock = quality_clock
 
     def predict(self, request: bytes, context) -> bytes:
         try:
@@ -153,6 +162,7 @@ class PredictServicer:
         self._observe_trace(
             trace_id, client_span_id, rpc_span_id, req, outcome,
             start_ts, max(0.0, time.monotonic() - start_mono),
+            outputs=outputs, features=features,
         )
         if abort is not None:
             context.abort(*abort)
@@ -160,7 +170,8 @@ class PredictServicer:
 
     def _observe_trace(self, trace_id: str, client_span_id: str,
                        rpc_span_id: str, req, outcome: str,
-                       start_ts: float, duration_s: float):
+                       start_ts: float, duration_s: float,
+                       outputs=None, features=None):
         """Assemble the request's deferred span set — rpc.predict, the
         phase spans derived from the batcher's stamps, the shared
         serve.batch link — and feed the sampler.  All clocks were read
@@ -240,6 +251,8 @@ class PredictServicer:
                 batch=batch,
                 generation=generation,
                 bucket=bucket,
+                predictions=outputs,
+                features=features,
             )
         except Exception:
             logger.exception("request-trace observe failed")
@@ -274,6 +287,33 @@ class PredictServicer:
         payload["queue_depth"] = self._batcher.queue_depth()
         payload["ledger"] = ledger().snapshot()
         return json.dumps(payload).encode("utf-8")
+
+    def labels(self, request: bytes, context) -> bytes:
+        """Delayed feedback labels: npz keyed by trace id.  Unknown
+        trace ids (unsampled, expired, or pre-quality replica) are
+        absorbed, never errors — an at-least-once label feed must be
+        safe to replay against any replica."""
+        try:
+            mapping = decode_features(request)
+        except Exception as exc:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"bad labels payload: {exc}"
+            )
+        quality = self._quality
+        joined = 0
+        if quality is not None:
+            now = self._quality_clock()
+            for trace_id, label_arr in mapping.items():
+                try:
+                    if quality.note_label(trace_id, label_arr, now=now):
+                        joined += 1
+                except Exception:
+                    logger.exception("label join failed for %s", trace_id)
+        return json.dumps({
+            "received": len(mapping),
+            "joined": joined,
+            "enabled": quality is not None,
+        }).encode("utf-8")
 
 
 def add_PredictServicer_to_server(servicer, server):
@@ -318,8 +358,13 @@ class ServingFrontend:
         port: int = 0,
         max_workers: int = 16,
         sampler=None,
+        quality=None,
+        quality_clock=time.monotonic,
     ):
-        self._servicer = PredictServicer(replica, batcher, sampler=sampler)
+        self._servicer = PredictServicer(
+            replica, batcher, sampler=sampler, quality=quality,
+            quality_clock=quality_clock,
+        )
         self._server = grpc_utils.build_server(max_workers=max_workers)
         add_PredictServicer_to_server(self._servicer, self._server)
         self._requested_port = port
@@ -392,6 +437,23 @@ class PredictClient:
         payload = self._stub.reload(
             json.dumps({"model_dir": model_dir}).encode("utf-8"),
             timeout=deadline_s,
+        )
+        return json.loads(payload.decode("utf-8"))
+
+    def send_labels(self, labels: Dict[str, np.ndarray],
+                    deadline_s: float = 10.0) -> dict:
+        """Deliver delayed feedback labels keyed by trace id.  Retried
+        (at-least-once is safe: a duplicate delivery lands as an orphan
+        on the server, never a double join)."""
+        payload = grpc_utils.call_with_retry(
+            self._stub.labels,
+            encode_features(labels),
+            method="labels",
+            policy=grpc_utils.RetryPolicy(
+                timeout_s=deadline_s, max_attempts=3, wait_for_ready=True
+            ),
+            stats=self._stats,
+            seed=self._addr,
         )
         return json.loads(payload.decode("utf-8"))
 

@@ -264,12 +264,20 @@ class ExemplarSampler:
         capacity: int = 64,
         replica_id: Optional[int] = None,
         journal=None,
+        quality=None,
+        quality_clock=time.monotonic,
     ):
         self._head_every = max(0, int(head_every))
         self._tail_threshold_ms = float(tail_threshold_ms)
         self._capacity = max(1, int(capacity))
         self._replica_id = replica_id
         self._journal = journal
+        # Model-quality label-join ledger (obs/quality.py): sampled
+        # SERVED requests' predictions enter its pending-join ring, so
+        # the quality plane rides the same O(sampled) decision this
+        # sampler already makes — no second sampling policy to tune.
+        self._quality = quality
+        self._quality_clock = quality_clock
         self._lock = make_lock("ExemplarSampler._lock")
         self._count = 0  # traced requests seen, guarded-by: _lock
         self._sampled = 0  # guarded-by: _lock
@@ -297,6 +305,8 @@ class ExemplarSampler:
         batch: Optional[dict] = None,
         generation: Optional[int] = None,
         bucket: Optional[int] = None,
+        predictions=None,
+        features=None,
     ) -> str:
         """Feed one completed request; returns the sampling reason
         (``head`` / ``tail`` / ``outcome``) or ``""`` when unsampled.
@@ -304,7 +314,13 @@ class ExemplarSampler:
         ``spans`` is the deferred span payload list (record_span kwargs,
         prepared by the frontend with wall stamps already read);
         ``batch`` is the shared serve.batch payload (must carry
-        ``span_id``).  Both journal only on a sample."""
+        ``span_id``).  Both journal only on a sample.
+
+        ``predictions``/``features`` (host arrays, already synced by
+        the caller) feed the quality ledger's pending-join ring when
+        this sampler has one — only for sampled SERVED requests, so
+        label joins score exactly the population the trace plane
+        exemplifies."""
         if not trace_id:
             return ""
         if latency_s is None:
@@ -372,6 +388,18 @@ class ExemplarSampler:
             tracing.record_span(**batch)
         for payload in spans or ():
             tracing.record_span(**payload)
+        if (
+            self._quality is not None
+            and outcome == "served"
+            and predictions is not None
+        ):
+            try:
+                self._quality.note_prediction(
+                    trace_id, predictions, now=self._quality_clock(),
+                    features=features,
+                )
+            except Exception:
+                logger.exception("quality note_prediction failed (ignored)")
         return sampled_by
 
     # -- readouts -------------------------------------------------------

@@ -138,6 +138,43 @@ def parse_replica_args(argv=None) -> argparse.Namespace:
                         help="tail-exemplar latency threshold; 0 ties it "
                              "to --slo_p99_ms (the SLO the fleet pages "
                              "on defines 'slow')")
+    parser.add_argument("--quality_join_window_s", type=float, default=0.0,
+                        help="label-join watermark window of the model-"
+                             "quality plane (obs/quality.py): sampled "
+                             "predictions wait this long for their "
+                             "delayed label; 0 disables the whole plane "
+                             "(ledger, drift sketches, canary gate)")
+    parser.add_argument("--quality_window_size", type=int, default=2048,
+                        help="joined (prediction, label) pairs in the "
+                             "online AUC/logloss window")
+    parser.add_argument("--quality_gate_max_logloss_regress", type=float,
+                        default=0.10,
+                        help="candidate-vs-live logloss regression that "
+                             "HOLDs a delta swap")
+    parser.add_argument("--quality_gate_max_auc_drop", type=float,
+                        default=0.05,
+                        help="candidate-vs-live AUC drop that HOLDs a "
+                             "delta swap")
+    parser.add_argument("--quality_gate_min_rows", type=int, default=64,
+                        help="labeled replay rows required before the "
+                             "gate can score (below = quality unknown)")
+    parser.add_argument("--quality_unknown_policy", default="open",
+                        choices=("open", "closed"),
+                        help="gate verdict when quality is unknown "
+                             "(label outage / cold buffer): open passes "
+                             "the swap, closed holds it")
+    parser.add_argument("--quality_gate_force", action="store_true",
+                        help="escape hatch: swap even on a beyond-"
+                             "threshold regression (journaled "
+                             "outcome=forced)")
+    parser.add_argument("--quality_drift_threshold", type=float,
+                        default=0.25,
+                        help="train-serve sketch divergence (total "
+                             "variation) that journals a quality_drift "
+                             "breach")
+    parser.add_argument("--quality_slo_logloss", type=float, default=0.0,
+                        help="online-logloss bound for the model_quality "
+                             "SLO; 0 registers no quality SLO")
     args, unknown = parser.parse_known_args(argv)
     if unknown:
         logger.warning("Ignoring unknown replica args: %s", unknown)
@@ -150,7 +187,7 @@ def _build_slo_plane(args):
     sparklines); SLO specs register only when their flags opt in.
     Ticked by the telemetry loop — one periodic thread, not two."""
     from elasticdl_tpu.obs.slo import (
-        SLOPlane, freshness_slo, serving_availability_slo,
+        SLOPlane, freshness_slo, quality_slo, serving_availability_slo,
         serving_latency_slo,
     )
 
@@ -168,15 +205,66 @@ def _build_slo_plane(args):
         specs.append(freshness_slo(
             args.freshness_slo_s, compliance_window_s=window_s
         ))
+    if args.quality_slo_logloss > 0 and args.quality_join_window_s > 0:
+        specs.append(quality_slo(
+            args.quality_slo_logloss, compliance_window_s=window_s
+        ))
     return SLOPlane(specs=specs, origin=f"replica_{args.replica_id}")
+
+
+def _build_quality_plane(args):
+    """The model-quality plane (obs/quality.py), all-or-nothing on
+    `--quality_join_window_s`: label-join ledger feeding a replay
+    buffer, drift monitor, and the canary gate the DeltaWatcher runs
+    every delta link through.  Returns (quality, drift, gate) —
+    (None, None, None) when disabled, so the rest of main() wires
+    nothing and the replica behaves byte-identically to pre-quality."""
+    if args.quality_join_window_s <= 0:
+        return None, None, None
+    from elasticdl_tpu.obs.quality import (
+        CanaryGate, DriftMonitor, QualityLedger, ReplayBuffer,
+    )
+
+    origin = f"replica_{args.replica_id}"
+    replay = ReplayBuffer()
+    quality = QualityLedger(
+        window_size=args.quality_window_size,
+        join_window_s=args.quality_join_window_s,
+        origin=origin,
+        replay=replay,
+    )
+    drift = DriftMonitor(
+        threshold=args.quality_drift_threshold, origin=origin
+    )
+    gate = CanaryGate(
+        replay,
+        max_logloss_regress=args.quality_gate_max_logloss_regress,
+        max_auc_drop=args.quality_gate_max_auc_drop,
+        min_rows=args.quality_gate_min_rows,
+        unknown_policy=args.quality_unknown_policy,
+        force=args.quality_gate_force,
+    )
+    return quality, drift, gate
 
 
 def _telemetry_loop(stop: threading.Event, interval_s: float, replica,
                     batcher, replica_id: int, slo_plane=None,
-                    sampler=None):
+                    sampler=None, quality=None, drift=None):
     from elasticdl_tpu.serving.ledger import ledger
 
     while not stop.wait(interval_s):
+        if quality is not None:
+            try:
+                # Window gauges BEFORE the SLO tick samples the
+                # registry, so the quality SLO never scores stale data.
+                quality.journal_window(time.monotonic())
+            except Exception:
+                logger.exception("quality window journal failed")
+        if drift is not None:
+            try:
+                drift.evaluate(time.monotonic())
+            except Exception:
+                logger.exception("drift evaluation failed")
         if slo_plane is not None:
             try:
                 slo_plane.tick()
@@ -247,6 +335,7 @@ def main(argv=None) -> int:
         sparse_kernel=args.sparse_kernel,
         model_zoo=args.model_zoo,
     )
+    quality, drift, gate = _build_quality_plane(args)
     book = ledger()
     batcher = MicroBatcher(
         replica.execute,
@@ -257,6 +346,7 @@ def main(argv=None) -> int:
         ),
         on_request=book.record_request,
         on_shed=book.record_shed,
+        on_batch=(drift.observe_serve if drift is not None else None),
     ).start()
     tail_ms = args.trace_tail_threshold_ms or args.slo_p99_ms
     sampler = ExemplarSampler(
@@ -264,6 +354,7 @@ def main(argv=None) -> int:
         tail_threshold_ms=tail_ms,
         capacity=args.trace_exemplar_capacity,
         replica_id=args.replica_id,
+        quality=quality,
     )
     # Every resource below owns a daemon thread and/or a listening
     # socket; a failure anywhere between start() and the serve loop
@@ -283,7 +374,7 @@ def main(argv=None) -> int:
             logger.info("Warmed %d bucket shapes", len(batcher.buckets))
 
         frontend = ServingFrontend(replica, batcher, port=args.port,
-                                   sampler=sampler)
+                                   sampler=sampler, quality=quality)
         port = frontend.start()
         slo_plane = _build_slo_plane(args)
         # Latency pages carry evidence: the slowest sampled trace ids at
@@ -319,7 +410,7 @@ def main(argv=None) -> int:
         telemetry = threading.Thread(
             target=_telemetry_loop,
             args=(stop, args.telemetry_interval_s, replica, batcher,
-                  args.replica_id, slo_plane, sampler),
+                  args.replica_id, slo_plane, sampler, quality, drift),
             name="serving-telemetry",
             daemon=True,
         )
@@ -335,11 +426,14 @@ def main(argv=None) -> int:
                 else None
             )
             watcher = DeltaWatcher(
-                replica, args.pub_dir, freshness=freshness
+                replica, args.pub_dir, freshness=freshness,
+                gate=gate, buckets=batcher.buckets,
+                origin=f"replica_{args.replica_id}",
             ).start(args.pub_poll_interval_s)
             logger.info(
-                "Tracking delta chain in %s every %.1fs", args.pub_dir,
+                "Tracking delta chain in %s every %.1fs%s", args.pub_dir,
                 args.pub_poll_interval_s,
+                " (canary-gated)" if gate is not None else "",
             )
 
         while not stop.wait(0.5):

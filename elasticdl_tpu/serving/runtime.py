@@ -322,19 +322,37 @@ class ServingReplica:
         )
         return new_gen
 
-    def apply_delta(self, delta_dir: str) -> Generation:
-        """Apply a delta checkpoint WITHOUT a full reload: patch the
-        current generation's host tables row-wise, re-place them with the
-        generation's own shardings, and reuse its compiled step (shapes
-        and placement are unchanged by construction — no recompile, no
-        retrace).  The pointer swap + drain are the same protocol as
-        `reload`.
+    def shadow_execute(self, features: Dict[str, np.ndarray],
+                       generation: Optional[Generation] = None):
+        """Run the compiled step against an EXPLICIT generation without
+        touching the serving pointer — the canary gate's evaluation
+        path: a built-but-uncommitted candidate generation answers the
+        replay batches while the live one keeps serving.  Defaults to
+        the current generation (the gate's baseline side)."""
+        gen = generation if generation is not None else self.generation
+        gen.begin()
+        try:
+            return np.asarray(gen.serve_fn(gen.variables, features))
+        finally:
+            gen.end()
+
+    def build_delta_generation(self, delta_dir: str) -> Generation:
+        """Build (but do NOT serve) the generation a delta checkpoint
+        would produce: patch the current generation's host tables
+        row-wise, re-place them with the generation's own shardings,
+        and reuse its compiled step (shapes and placement are unchanged
+        by construction — no recompile, no retrace).  The serving
+        pointer is untouched; `commit_generation` performs the swap.
+        Splitting build from commit is what lets the canary gate
+        shadow-evaluate the candidate BEFORE any traffic sees it — a
+        held candidate is simply dropped (its gen id burns; ids are
+        monotone, not dense).
 
         Any failure — injected `serving.delta_apply` fault, integrity
         mismatch (the delta is quarantined), a chain gap (base_step !=
-        the serving step) — rolls back atomically: the pointer never
-        moved, the old generation keeps answering, and the journal
-        carries a `model_swap` with ``outcome=rolled_back``."""
+        the serving step) — leaves the old generation serving and
+        journals a `model_swap` with ``outcome=rolled_back``, then
+        re-raises."""
         from elasticdl_tpu.common import faults
         from elasticdl_tpu.checkpoint import delta as deltas
         from elasticdl_tpu.checkpoint.saver import verify_integrity
@@ -438,7 +456,21 @@ class ServingReplica:
                 "keeps serving", delta_dir, old_gen.gen_id, old_gen.step,
             )
             raise
-        return self._swap(new_gen, delta_dir, kind="delta")
+        return new_gen
+
+    def commit_generation(self, new_gen: Generation,
+                          model_dir: str) -> Generation:
+        """Serve a generation built by `build_delta_generation`: the
+        same pointer-swap + drain protocol as `reload` (journaled
+        `model_swap` kind="delta" outcome="applied")."""
+        return self._swap(new_gen, model_dir, kind="delta")
+
+    def apply_delta(self, delta_dir: str) -> Generation:
+        """Build + commit in one step — the ungated path (and the
+        original API).  See `build_delta_generation` for the failure
+        contract."""
+        return self.commit_generation(
+            self.build_delta_generation(delta_dir), delta_dir)
 
     # -- readouts --------------------------------------------------------
 

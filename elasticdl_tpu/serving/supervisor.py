@@ -207,6 +207,15 @@ def replica_argv_fn(
     trace_head_every: int = 128,
     trace_exemplar_capacity: int = 64,
     trace_tail_threshold_ms: float = 0.0,
+    quality_join_window_s: float = 0.0,
+    quality_window_size: int = 2048,
+    quality_gate_max_logloss_regress: float = 0.10,
+    quality_gate_max_auc_drop: float = 0.05,
+    quality_gate_min_rows: int = 64,
+    quality_unknown_policy: str = "open",
+    quality_gate_force: bool = False,
+    quality_drift_threshold: float = 0.25,
+    quality_slo_logloss: float = 0.0,
     python: str = sys.executable,
 ) -> Callable[[int], List[str]]:
     """The pod manager's `worker_argv_fn` for serving replicas: the
@@ -253,6 +262,24 @@ def replica_argv_fn(
                 "--trace_exemplar_capacity", str(trace_exemplar_capacity),
                 "--trace_tail_threshold_ms", str(trace_tail_threshold_ms),
             ]
+        if quality_join_window_s > 0:
+            # Model-quality plane (obs/quality.py): the join window is
+            # the master switch; only forwarded when armed, so
+            # pre-quality argv pins stay byte-identical.
+            cmd += [
+                "--quality_join_window_s", str(quality_join_window_s),
+                "--quality_window_size", str(quality_window_size),
+                "--quality_gate_max_logloss_regress",
+                str(quality_gate_max_logloss_regress),
+                "--quality_gate_max_auc_drop",
+                str(quality_gate_max_auc_drop),
+                "--quality_gate_min_rows", str(quality_gate_min_rows),
+                "--quality_unknown_policy", quality_unknown_policy,
+                "--quality_drift_threshold", str(quality_drift_threshold),
+                "--quality_slo_logloss", str(quality_slo_logloss),
+            ]
+            if quality_gate_force:
+                cmd += ["--quality_gate_force"]
         return cmd
 
     return argv

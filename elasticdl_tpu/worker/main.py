@@ -90,6 +90,17 @@ def main(argv=None):
         from elasticdl_tpu.parallel import packed
 
         packed.set_oov_debug(True)
+    if getattr(args, "quality_drift_bins", 0) > 0:
+        # Train-side skew sketch (obs/quality.py): every train batch's
+        # integer feature ids fold into a process-local DriftMonitor
+        # for train-serve divergence (host-side numpy, O(bins) memory).
+        from elasticdl_tpu.obs import quality
+
+        quality.enable_train_sketch(quality.DriftMonitor(
+            threshold=args.quality_drift_threshold,
+            bins=args.quality_drift_bins,
+            origin=f"worker_{args.worker_id}",
+        ))
     model_spec = load_model_spec(args)
     data_reader = build_data_reader(args, model_spec, args.training_data)
     validation_reader = (

@@ -22,7 +22,7 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import ModelSpec
 from elasticdl_tpu.data.pipeline import PipelineConfig, Prefetcher
 from elasticdl_tpu.data.task_data_service import TaskDataService
-from elasticdl_tpu.obs import goodput
+from elasticdl_tpu.obs import goodput, quality
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.worker.trainer import Trainer
 
@@ -217,6 +217,10 @@ class Worker:
                 spec = faults.fire("worker.step")
                 if spec is not None and spec.kind == "crash":
                     faults.crash_now(spec)
+                # Train-side skew sketch (host-side, pre-staging host
+                # arrays — never a device read): no-op until
+                # --quality_drift_bins enables a monitor.
+                quality.note_train_batch(features)
                 if self._profiler is not None:
                     self._profiler.before_steps(self._trainer.step)
                 n = _batch_size_of(features)

@@ -105,6 +105,11 @@ UNTRACKED = frozenset(
         # as value/baseline < 1-spread, which would treat a LATENCY
         # IMPROVEMENT as a regression — permanently report-only.
         "deepfm_serve_p99_ms",
+        # Quality-plane math anchor (bench_deepfm_online_auc_window):
+        # a synthetic fixed-separation scorer, so the value measures
+        # the ledger's window math, never model quality — permanently
+        # report-only.
+        "deepfm_online_auc_window",
         "bench_backend_probe",
     }
 )

@@ -431,6 +431,72 @@ def run_open_loop(
     return result
 
 
+# ---------------------------------------------------------------------------
+# Delayed-label replay (the quality plane's feedback half)
+# ---------------------------------------------------------------------------
+
+
+def label_mapping(
+    stream: RequestStream, indices: Sequence[int]
+) -> Optional[Dict[str, np.ndarray]]:
+    """Ground-truth labels for the given request indices, keyed by the
+    trace ids the predictions carried — the replica's label-join ledger
+    matches them against its pending predictions.  Labels come from the
+    same pure rule as the training stream (data/stream.click_label_rule
+    via feedback_labels), so the `stream.labels` fault site applies here
+    too: poisoned feeds flip, outages return None for the whole group.
+    """
+    from elasticdl_tpu.data.stream import feedback_labels
+
+    mapping: Dict[str, np.ndarray] = {}
+    for i in indices:
+        labels = feedback_labels(stream.request(i))
+        if labels is None:
+            return None  # label-feed outage: the group is lost
+        mapping[trace_id_for(stream.config.seed, i)] = labels
+    return mapping
+
+
+def run_label_feed(
+    send_fns: Sequence[Callable[[Dict[str, np.ndarray]], dict]],
+    stream: RequestStream,
+    num_requests: int,
+    group: int = 32,
+    delay_s: float = 0.0,
+    sleep=time.sleep,
+) -> dict:
+    """Replay delayed labels for requests [0, num_requests) in groups.
+    Each group is BROADCAST to every send_fn — the feed does not know
+    which replica served a given prediction, so every replica sees every
+    label and the non-owners record orphans (bounded, and exactly what a
+    production at-least-once label bus does).  Returns the feed summary;
+    send failures and outages are counted, never raised."""
+    stats = {
+        "groups": 0, "outages": 0, "send_errors": 0,
+        "labels_sent": 0, "received": 0, "joined": 0,
+    }
+    for start in range(0, num_requests, max(1, group)):
+        if delay_s > 0:
+            sleep(delay_s)
+        indices = range(start, min(start + max(1, group), num_requests))
+        mapping = label_mapping(stream, indices)
+        stats["groups"] += 1
+        if mapping is None:
+            stats["outages"] += 1
+            continue
+        stats["labels_sent"] += len(mapping)
+        for send_fn in send_fns:
+            try:
+                reply = send_fn(mapping)
+            except Exception:  # feed keeps going; the gate degrades
+                stats["send_errors"] += 1
+                continue
+            if isinstance(reply, dict):
+                stats["received"] += int(reply.get("received", 0))
+                stats["joined"] += int(reply.get("joined", 0))
+    return stats
+
+
 def round_robin_predict(predict_fns: Sequence[Callable]) -> Callable:
     """One predict_fn spreading requests across replicas."""
     if not predict_fns:
@@ -561,6 +627,70 @@ def _selftest(slowest: int = 0) -> int:
     return 0
 
 
+def _selftest_labels() -> int:
+    """No-server sanity of the delayed-label replay half: labels are
+    pure in (seed, i) with the training stream's positive rate, groups
+    broadcast to every target with join accounting, and a label-feed
+    outage (`stream.labels:truncate`) loses groups without raising."""
+    from elasticdl_tpu.common import faults
+
+    faults.clear()
+    cfg = StreamConfig(seed=7, batch_rows=4, vocab_size=50)
+    stream = RequestStream(cfg)
+    a = label_mapping(stream, range(8))
+    b = label_mapping(stream, range(8))
+    if a is None or set(a) != {trace_id_for(7, i) for i in range(8)} or \
+            not all(np.array_equal(a[k], b[k]) for k in a):
+        print("label selftest FAILED: mapping not deterministic",
+              file=sys.stderr)
+        return 1
+    rate = float(np.mean(np.concatenate(list(a.values()))))
+    if not 0.05 < rate < 0.65:
+        print(f"label selftest FAILED: positive rate {rate}",
+              file=sys.stderr)
+        return 1
+
+    deliveries: List[int] = []
+
+    def send_ok(mapping):
+        deliveries.append(len(mapping))
+        return {"received": len(mapping), "joined": len(mapping) - 1,
+                "enabled": True}
+
+    def send_broken(mapping):
+        raise RuntimeError("replica gone")
+
+    stats = run_label_feed(
+        [send_ok, send_broken], stream, num_requests=20, group=8,
+        sleep=lambda s: None,
+    )
+    if stats["groups"] != 3 or stats["labels_sent"] != 20 or \
+            stats["send_errors"] != 3 or stats["received"] != 20 or \
+            stats["joined"] != 17 or deliveries != [8, 8, 4]:
+        print(f"label selftest FAILED: feed stats {stats}", file=sys.stderr)
+        return 1
+    # Outage: the second group's fetch returns None (site fires once per
+    # request in the group; group 2 starts at call 9).
+    faults.install("stream.labels:truncate@9")
+    try:
+        stats = run_label_feed(
+            [send_ok], stream, num_requests=24, group=8,
+            sleep=lambda s: None,
+        )
+    finally:
+        faults.clear()
+    if stats["outages"] != 1 or stats["labels_sent"] != 16 or \
+            stats["groups"] != 3:
+        print(f"label selftest FAILED: outage stats {stats}",
+              file=sys.stderr)
+        return 1
+    print(
+        f"loadgen label selftest OK (rate {rate:.2f}, outage lost 1 "
+        "group, send errors non-fatal)"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Deterministic serving load generator."
@@ -593,10 +723,20 @@ def main(argv=None) -> int:
     parser.add_argument("--no_trace", action="store_true",
                         help="do not attach trace ids / journal client "
                              "spans (pre-tracing wire behaviour)")
+    parser.add_argument("--labels", action="store_true",
+                        help="after the run, replay delayed ground-truth "
+                             "labels (keyed by the requests' trace ids) to "
+                             "every target's labels RPC — feeds the "
+                             "replicas' online label-join quality ledger")
+    parser.add_argument("--label_delay_s", type=float, default=0.0,
+                        help="pause before each label group (simulated "
+                             "feedback delay)")
+    parser.add_argument("--label_group", type=int, default=32,
+                        help="labels delivered per replay group")
     parser.add_argument("--selftest", action="store_true")
     args = parser.parse_args(argv)
     if args.selftest:
-        return _selftest(args.slowest)
+        return _selftest_labels() if args.labels else _selftest(args.slowest)
 
     addrs = list(args.addr)
     if args.serve_dir:
@@ -629,6 +769,15 @@ def main(argv=None) -> int:
             predict, stream, args.requests, args.concurrency, trace=tracer
         )
     summary = {"targets": addrs, **result.summary()}
+    if args.labels:
+        if args.no_trace:
+            print("--labels needs trace ids; drop --no_trace",
+                  file=sys.stderr)
+            return 2
+        summary["label_feed"] = run_label_feed(
+            [c.send_labels for c in clients], stream, result.requests,
+            group=args.label_group, delay_s=args.label_delay_s,
+        )
     if tracer is not None and args.slowest:
         summary["slowest"] = tracer.slowest(args.slowest)
     text = json.dumps(summary, indent=2)

@@ -131,6 +131,16 @@ EVENT_REQUIRED_FIELDS = {
     # O(sampled), never O(requests); the trace id is journal-only per
     # the cardinality rule.
     "request_trace": ("trace_id", "outcome", "sampled_by"),
+    # Model-quality plane (obs/quality.py — docs/observability.md
+    # "Model quality").  `quality_window` is the periodic online-metric
+    # rollup of the label-join ledger (AUC/logloss/calibration ride as
+    # optional fields — a window can be labelless); `quality_drift`
+    # fires on train-serve divergence breach/clear EDGES only;
+    # `quality_gate` records every canary-gate verdict on a delta link
+    # (outcome passed|held|forced, with the shadow-eval evidence).
+    "quality_window": ("joined", "origin"),
+    "quality_drift": ("state", "divergence", "origin"),
+    "quality_gate": ("outcome", "step", "origin"),
 }
 
 #: Every event type the repo is ALLOWED to emit.  Journal FILES stay
@@ -274,6 +284,15 @@ EVENT_OPTIONAL_FIELDS = {
     "checkpoint_saved": ("step", "kind", "n_processes", "event_time"),
     "checkpoint_restored": ("step", "kind"),
     "checkpoint_quarantined": ("path", "reason"),
+    "quality_window": (
+        "window", "pending", "expired", "orphans", "auc", "logloss",
+        "calibration_error", "prediction_mean", "label_mean", "entropy",
+    ),
+    "quality_drift": ("threshold",),
+    "quality_gate": (
+        "delta_dir", "reason", "rows", "quality", "baseline_logloss",
+        "candidate_logloss", "baseline_auc", "candidate_auc",
+    ),
 }
 assert set(EVENT_OPTIONAL_FIELDS) == set(KNOWN_EVENTS), (
     "EVENT_OPTIONAL_FIELDS must carry an entry (possibly empty) for "
@@ -546,6 +565,23 @@ def _selftest() -> int:
          "execute_p99_ms": 17.4, "respond_p99_ms": 0.3,
          "exemplar": {"trace_id": "lg7-00000102", "latency_ms": 81.2,
                       "dominant_phase": "queue"}},
+        # Model-quality plane (PR 20): the windowed online-eval rollup, a
+        # drift breach edge, and a canary-gate hold with its shadow-eval
+        # evidence (docs/observability.md "Model quality").
+        {"ts": 7.5, "event": "quality_window", "joined": 512,
+         "origin": "replica_0", "window": 512, "pending": 9, "expired": 3,
+         "orphans": 1, "auc": 0.71, "logloss": 0.48,
+         "calibration_error": 0.04, "prediction_mean": 0.31,
+         "label_mean": 0.3, "entropy": 0.58},
+        {"ts": 7.52, "event": "quality_drift", "state": "breach",
+         "divergence": 0.41, "threshold": 0.25, "origin": "replica_0"},
+        {"ts": 7.54, "event": "quality_gate", "outcome": "held",
+         "step": 4224, "origin": "replica_0",
+         "delta_dir": "/pub/delta_000000004160_000000004224",
+         "reason": "logloss_regress:0.3120", "rows": 192,
+         "quality": "known", "baseline_logloss": 0.48,
+         "candidate_logloss": 0.79, "baseline_auc": 0.71,
+         "candidate_auc": 0.55},
         {"ts": 7.3, "event": "some_future_event", "anything": "goes"},
     ]
     bad_lines = [
@@ -574,6 +610,10 @@ def _selftest() -> int:
         ' "outcome": "served"}',                        # no sampled_by
         '{"ts": 1.4999, "event": "request_trace", "outcome": "shed",'
         ' "sampled_by": "outcome"}',                    # no trace_id
+        '{"ts": 1.49991, "event": "quality_window", "auc": 0.7}',  # no joined
+        '{"ts": 1.49992, "event": "quality_drift", "state": "breach"}',
+        '{"ts": 1.49993, "event": "quality_gate", "step": 4224,'
+        ' "origin": "replica_0"}',                      # no outcome
         '{"ts": 1.5, "event": "phase_transition", "from": "idle"}',  # no to
         '{"ts": 1.6, "event": "rescale_cost", "cause": "scale"}',  # no costs
         '{"event": "rendezvous", "rendezvous_id": 1, "world_size": 1}',  # no ts

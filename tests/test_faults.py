@@ -5,6 +5,7 @@ perturbs (checkpoint/saver.py)."""
 import os
 import zlib
 
+import numpy as np
 import pytest
 
 from elasticdl_tpu.common import faults
@@ -196,6 +197,62 @@ def test_continuous_loop_sites_fire_independently():
     hit = faults.fire("serving.delta_apply")
     assert hit.kind == "error" and hit.arg == "injected"
     assert faults.fire("stream.source") is None  # never installed
+
+
+def test_parse_quality_plane_sites():
+    specs = faults.parse_specs(
+        "stream.labels:error=flip@2x3,"
+        " stream.labels:truncate@9,"
+        " quality.label_join:error@1,"
+        " quality.shadow_eval:error=poisoned-eval@1x*"
+    )
+    poison, outage, drop, shadow = specs
+
+    # Poisoned feed: every label in the fetched range flips — the
+    # label-flipped-shard chaos scenario the canary gate must hold.
+    assert poison.site == "stream.labels"
+    assert poison.kind == "error" and poison.arg == "flip"
+    assert poison.triggers_at(2) and poison.triggers_at(4)
+    assert not poison.triggers_at(1) and not poison.triggers_at(5)
+
+    # Outage: the range returns None — no labels arrive, quality goes
+    # UNKNOWN (the gate's configurable-policy path, never a crash).
+    assert outage.site == "stream.labels"
+    assert outage.kind == "truncate" and outage.triggers_at(9)
+
+    # Join-side drop and at-least-once duplicate ride the same site.
+    assert drop.site == "quality.label_join"
+    assert drop.kind == "error" and drop.triggers_at(1)
+
+    # Shadow-eval blowup: forever-firing spec (x*) keeps quality
+    # unknown across every poll — the degradation the e2e pins.
+    assert shadow.site == "quality.shadow_eval"
+    assert shadow.kind == "error" and shadow.arg == "poisoned-eval"
+    assert shadow.count == -1 and shadow.triggers_at(500)
+
+
+def test_quality_sites_fire_independently():
+    faults.install(
+        "stream.labels:truncate@1, quality.label_join:truncate@1,"
+        " quality.shadow_eval:error@1"
+    )
+    assert faults.fire("stream.labels").kind == "truncate"
+    assert faults.fire("stream.labels") is None  # exhausted
+    assert faults.fire("quality.label_join").kind == "truncate"
+    assert faults.fire("quality.shadow_eval").kind == "error"
+    assert faults.fire("quality.shadow_eval") is None
+
+
+def test_stream_labels_fault_flips_and_blacks_out():
+    from elasticdl_tpu.data import stream
+
+    feats = stream.synthetic_click_batch(0, 16, 100)
+    clean = stream.click_label_rule(feats)
+    faults.install("stream.labels:error@1, stream.labels:truncate@2")
+    flipped = stream.feedback_labels(feats)
+    assert np.array_equal(flipped, 1.0 - clean)  # poisoned: all flipped
+    assert stream.feedback_labels(feats) is None  # outage: no labels
+    assert np.array_equal(stream.feedback_labels(feats), clean)  # healthy
 
 
 # ---------------------------------------------------------------------------
